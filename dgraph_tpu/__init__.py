@@ -1,0 +1,27 @@
+"""dgraph_tpu — a TPU-native distributed graph query engine.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of Dgraph v0.7
+(the reference graph database surveyed in SURVEY.md): GraphQL±-style
+queries over an RDF-ingested, predicate-sharded posting-list store.
+
+Architecture (TPU-first, not a port):
+
+- ``ops``      batched set-algebra kernels over padded sorted int32 uid sets
+               (the TPU-native equivalent of the reference's algo/uidlist.go).
+- ``models``   data model: host posting store with mutation semantics, the
+               device-resident CSR "arenas" (the equivalent of posting/ +
+               badger), schema state, value types.
+- ``gql``      GraphQL± lexer/parser (equivalent of gql/ + lex/).
+- ``rdf``      N-Quad mutation parser (equivalent of rdf/).
+- ``tok``      tokenizers feeding secondary indexes (equivalent of tok/).
+- ``query``    the SubGraph execution engine: level-batched device traversal,
+               filters, sort, vars, aggregation, output encoding
+               (equivalent of query/ + worker/task.go).
+- ``parallel`` mesh sharding of arenas + collective frontier expansion
+               (equivalent of group/ + worker routing, built on shard_map).
+- ``serve``    HTTP serving surface, bulk loader, export
+               (equivalent of cmd/dgraph + dgraph/ + client/).
+- ``utils``    metrics, errors, config (equivalent of x/).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,28 @@
+"""Batched set-algebra kernels over sorted uid sets.
+
+TPU-native equivalent of the reference's algo/ package
+(/root/reference/algo/uidlist.go:42-300): intersection, union (k-way merge
+with dedup), difference, binary membership and CSR posting-list expansion —
+re-designed as fixed-shape, mask-padded JAX programs instead of pointer
+chasing over variable-length slices.
+"""
+
+from dgraph_tpu.ops.sets import (  # noqa: F401
+    SENT,
+    bucket,
+    pad_to,
+    compact,
+    sort_unique,
+    intersect,
+    difference,
+    union,
+    intersect_many,
+    union_many,
+    member_mask,
+    mask_to_set,
+    expand_csr,
+    count_valid,
+    rows_of,
+    range_rows,
+)
+from dgraph_tpu.ops import ref  # noqa: F401

@@ -1,0 +1,53 @@
+"""NumPy reference implementations of the set-algebra ops.
+
+Direct, obviously-correct transcriptions of the semantics of the
+reference's algo/uidlist.go (IntersectWith/IntersectSorted/MergeSorted/
+Difference/IndexOf/ApplyFilter) over variable-length sorted arrays.
+Property tests (tests/test_ops.py) check the JAX kernels against these on
+random inputs — the differential-testing seam SURVEY.md §4 calls for.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def intersect(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.intersect1d(a, b)
+
+
+def intersect_many(lists) -> np.ndarray:
+    lists = list(lists)
+    if not lists:
+        return np.empty(0, dtype=np.int64)
+    acc = np.asarray(lists[0])
+    for l in lists[1:]:
+        acc = np.intersect1d(acc, l)
+    return acc
+
+
+def union(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.union1d(a, b)
+
+
+def union_many(lists) -> np.ndarray:
+    lists = [np.asarray(l) for l in lists]
+    if not lists:
+        return np.empty(0, dtype=np.int64)
+    return np.unique(np.concatenate(lists))
+
+
+def difference(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.setdiff1d(a, b)
+
+
+def member_mask(a: np.ndarray, s: np.ndarray) -> np.ndarray:
+    return np.isin(a, s)
+
+
+def expand_csr(offsets: np.ndarray, dst: np.ndarray, rows) -> np.ndarray:
+    """Concatenated posting lists for the given row indices (skip negatives)."""
+    parts = [dst[offsets[r] : offsets[r + 1]] for r in rows if r >= 0]
+    if not parts:
+        return np.empty(0, dtype=dst.dtype)
+    return np.concatenate(parts)

@@ -1,0 +1,216 @@
+"""Core fixed-shape sorted-set kernels (JAX).
+
+Data representation
+-------------------
+A *uid set* is an int32 vector, sorted ascending, with all padding slots
+holding ``SENT`` (int32 max).  Because the sentinel is the maximum value,
+padding always sorts to the end, so "compact the valid entries" is just a
+sort.  All kernels preserve this invariant: inputs and outputs are
+sorted-unique-padded unless documented otherwise.
+
+Why this shape: the reference's algo layer (algo/uidlist.go:42-300 in
+/root/reference) walks variable-length sorted []uint64 slices with adaptive
+linear/galloping/binary intersection.  On TPU, data-dependent branching is
+poison; instead every op is a fixed-shape vector program — searchsorted
+(binary search vectorized over lanes), sort (bitonic on the VPU), masked
+select — which XLA fuses and tiles.  Dynamic result sizes are handled by
+power-of-two *bucketing* of capacities (``bucket``) so jit caches a small
+number of compiled shapes.
+
+uids are dense int32 "local ids" assigned at ingest by the uid dictionary
+(models/uids.py), not the reference's sparse uint64 space: 64-bit ints are
+emulated (slow) on TPU, and dense ids double as direct indexes into value
+arenas.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Padding sentinel: int32 max. Sorts after every valid uid.
+SENT = (1 << 31) - 1
+
+
+def bucket(n: int, floor: int = 8) -> int:
+    """Round ``n`` up to a power of two (>= floor) to bound jit cache size."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_to(x: np.ndarray, size: int) -> np.ndarray:
+    """Pad a host int array to ``size`` with SENT (host-side helper)."""
+    x = np.asarray(x, dtype=np.int32)
+    out = np.full(size, SENT, dtype=np.int32)
+    out[: x.shape[0]] = x
+    return out
+
+
+@jax.jit
+def count_valid(x: jnp.ndarray) -> jnp.ndarray:
+    """Number of non-padding entries."""
+    return jnp.sum(x != SENT).astype(jnp.int32)
+
+
+@jax.jit
+def compact(x: jnp.ndarray) -> jnp.ndarray:
+    """Re-establish the invariant after masking: sort so SENT pads the tail."""
+    return jnp.sort(x)
+
+
+@jax.jit
+def sort_unique(x: jnp.ndarray) -> jnp.ndarray:
+    """Sort and deduplicate a padded vector (not necessarily sorted/unique).
+
+    Equivalent of the dedup in algo.MergeSorted (algo/uidlist.go:249-296),
+    done as: sort, mark adjacent duplicates, replace with SENT, re-sort.
+    """
+    x = jnp.sort(x)
+    dup = jnp.concatenate([jnp.zeros((1,), dtype=bool), x[1:] == x[:-1]])
+    return jnp.sort(jnp.where(dup, SENT, x))
+
+
+@jax.jit
+def member_mask(a: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """Boolean mask: which entries of ``a`` are present in sorted-unique ``s``.
+
+    Vectorized binary search — the TPU analog of algo.IndexOf
+    (algo/uidlist.go:300) applied batchwise.  Padding entries map to False.
+    """
+    pos = jnp.clip(jnp.searchsorted(s, a), 0, s.shape[0] - 1)
+    return (s[pos] == a) & (a != SENT)
+
+
+@jax.jit
+def intersect(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a ∩ b for sorted-unique-padded sets (result shaped like ``a``).
+
+    Replaces algo.IntersectWith's adaptive linear/jump/binary variants
+    (algo/uidlist.go:42-181) with one uniform vectorized binary search —
+    the adaptivity is pointless on SIMD hardware where all lanes run anyway.
+    """
+    return jnp.sort(jnp.where(member_mask(a, b), a, SENT))
+
+
+@jax.jit
+def difference(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a \\ b for sorted-unique-padded sets (algo.Difference, uidlist.go:217)."""
+    keep = (~member_mask(a, b)) & (a != SENT)
+    return jnp.sort(jnp.where(keep, a, SENT))
+
+
+@jax.jit
+def union(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a ∪ b, result capacity |a|+|b| (algo.MergeSorted for k=2)."""
+    return sort_unique(jnp.concatenate([a, b]))
+
+
+@jax.jit
+def intersect_many(mat: jnp.ndarray) -> jnp.ndarray:
+    """Intersect the K rows of a [K, L] padded matrix (algo.IntersectSorted,
+    algo/uidlist.go:183-215).  The reference sorts lists smallest-first; on
+    TPU every fold step costs the same, so we just scan.
+    """
+    def body(acc, row):
+        return intersect(acc, row), None
+
+    acc, _ = jax.lax.scan(body, mat[0], mat[1:])
+    return acc
+
+
+@jax.jit
+def union_many(mat: jnp.ndarray) -> jnp.ndarray:
+    """Union of the K rows of a [K, L] padded matrix (k-way MergeSorted,
+    algo/uidlist.go:249 — the min-heap becomes one flat sort)."""
+    return sort_unique(mat.reshape(-1))
+
+
+@jax.jit
+def mask_to_set(values: jnp.ndarray, keep: jnp.ndarray) -> jnp.ndarray:
+    """Select ``values`` where ``keep``, as a sorted-unique-padded set."""
+    return sort_unique(jnp.where(keep, values, SENT))
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def expand_csr(
+    offsets: jnp.ndarray,
+    dst: jnp.ndarray,
+    rows: jnp.ndarray,
+    cap: int,
+):
+    """Batched posting-list gather: the single hot kernel of the engine.
+
+    Replaces the reference's per-key loop in worker.processTask
+    (worker/task.go:287-440: N badger lookups + N iterations) with one
+    vectorized CSR expansion over the device-resident arena.
+
+    Args:
+      offsets: int32[S+1] CSR row offsets of the arena.
+      dst:     int32[E] packed target uids, ascending within each row.
+      rows:    int32[B] arena row indices to expand; negative = skip.
+      cap:     static output capacity (bucketed total degree).
+
+    Returns:
+      out:   int32[cap] concatenated target uids, grouped by source (each
+             group sorted ascending), SENT-padded.
+      seg:   int32[cap] index into ``rows`` that produced each slot, -1 pad.
+             (out, seg) is the uid_matrix of the reference (task.proto:52)
+             in CSR form.
+      total: int32 scalar, number of valid slots.
+    """
+    nrows = rows.shape[0]
+    if dst.shape[0] == 0:  # edgeless arena: nothing to gather (static shape)
+        return (
+            jnp.full((cap,), SENT, dtype=jnp.int32),
+            jnp.full((cap,), -1, dtype=jnp.int32),
+            jnp.int32(0),
+        )
+    valid = rows >= 0
+    r = jnp.where(valid, rows, 0)
+    deg = jnp.where(valid, offsets[r + 1] - offsets[r], 0)
+    cum = jnp.cumsum(deg)
+    total = cum[-1] if nrows > 0 else jnp.int32(0)
+    start = cum - deg
+    i = jnp.arange(cap, dtype=jnp.int32)
+    # Owner of output slot i = first row whose cumulative degree exceeds i.
+    seg = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
+    segc = jnp.clip(seg, 0, nrows - 1)
+    within = i - start[segc]
+    edge = offsets[r[segc]] + within
+    ok = i < total
+    out = jnp.where(ok, dst[jnp.clip(edge, 0, dst.shape[0] - 1)], SENT)
+    return out, jnp.where(ok, segc, -1), total.astype(jnp.int32)
+
+
+@jax.jit
+def rows_of(src: jnp.ndarray, uids: jnp.ndarray) -> jnp.ndarray:
+    """Map uids to arena row indices via the sorted ``src`` column.
+
+    Returns int32[B]; -1 where the uid has no row (or is padding).
+    """
+    pos = jnp.clip(jnp.searchsorted(src, uids), 0, src.shape[0] - 1)
+    hit = (src[pos] == uids) & (uids != SENT)
+    return jnp.where(hit, pos.astype(jnp.int32), -1)
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def range_rows(lo: jnp.ndarray, hi: jnp.ndarray, cap: int):
+    """Row indices [lo, hi) as an int32[cap] vector, -1 padded.
+
+    Used for inequality functions: host binary-searches the sorted token
+    table for the bucket range, the device unions that contiguous range of
+    index posting lists (the analog of worker/sort.go's bucket walk and
+    worker/task.go:542-585's inequality handling).
+
+    Returns (rows, n) where n = hi - lo is the true count; like
+    expand_csr's ``total``, n > cap signals the caller chose too small a
+    cap and must re-bucket — the output alone is silently truncated.
+    """
+    i = jnp.arange(cap, dtype=jnp.int32)
+    n = (hi - lo).astype(jnp.int32)
+    return jnp.where(i < n, lo + i, -1), n

@@ -1,0 +1,23 @@
+"""Test env: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): in-process fixtures,
+no network, multi-"group" logic exercised in one process — here, a virtual
+multi-device mesh on CPU.
+
+Note: this image's sitecustomize imports jax at interpreter startup with
+JAX_PLATFORMS=axon, so env vars are already consumed; we must use
+jax.config.update (works any time before backend init) and set XLA_FLAGS
+before the first device query.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,149 @@
+"""Property tests: JAX set kernels == NumPy reference on random inputs.
+
+Mirrors algo/uidlist_test.go in the reference (random sorted lists,
+intersect/merge/difference correctness) plus CSR expansion.
+"""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu import ops
+from dgraph_tpu.ops import ref
+from dgraph_tpu.ops import SENT
+
+
+def rand_set(rng, max_len=64, max_val=200):
+    n = rng.integers(0, max_len + 1)
+    return np.unique(rng.integers(0, max_val, size=n)).astype(np.int32)
+
+
+def unpad(x):
+    x = np.asarray(x)
+    return x[x != SENT]
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(42)
+
+
+def test_sort_unique(rng):
+    for _ in range(20):
+        n = rng.integers(0, 50)
+        raw = rng.integers(0, 60, size=n).astype(np.int32)
+        cap = ops.bucket(max(1, n))
+        got = unpad(ops.sort_unique(ops.pad_to(raw, cap)))
+        np.testing.assert_array_equal(got, np.unique(raw))
+
+
+@pytest.mark.parametrize("op,refop", [
+    ("intersect", ref.intersect),
+    ("difference", ref.difference),
+])
+def test_binary_ops(rng, op, refop):
+    fn = getattr(ops, op)
+    for _ in range(30):
+        a, b = rand_set(rng), rand_set(rng)
+        cap = ops.bucket(max(1, len(a), len(b)))
+        got = unpad(fn(ops.pad_to(a, cap), ops.pad_to(b, cap)))
+        np.testing.assert_array_equal(got, refop(a, b))
+
+
+def test_union(rng):
+    for _ in range(30):
+        a, b = rand_set(rng), rand_set(rng)
+        cap = ops.bucket(max(1, len(a), len(b)))
+        got = unpad(ops.union(ops.pad_to(a, cap), ops.pad_to(b, cap)))
+        np.testing.assert_array_equal(got, ref.union(a, b))
+
+
+def test_intersect_many(rng):
+    for _ in range(10):
+        k = rng.integers(2, 6)
+        lists = [rand_set(rng, max_val=80) for _ in range(k)]
+        cap = ops.bucket(max(1, max(len(l) for l in lists)))
+        mat = np.stack([ops.pad_to(l, cap) for l in lists])
+        got = unpad(ops.intersect_many(mat))
+        np.testing.assert_array_equal(got, ref.intersect_many(lists))
+
+
+def test_union_many(rng):
+    for _ in range(10):
+        k = rng.integers(2, 6)
+        lists = [rand_set(rng, max_val=80) for _ in range(k)]
+        cap = ops.bucket(max(1, max(len(l) for l in lists)))
+        mat = np.stack([ops.pad_to(l, cap) for l in lists])
+        got = unpad(ops.union_many(mat))
+        np.testing.assert_array_equal(got, ref.union_many(lists))
+
+
+def test_member_mask(rng):
+    for _ in range(20):
+        a, s = rand_set(rng), rand_set(rng)
+        cap = ops.bucket(max(1, len(a), len(s)))
+        pa = ops.pad_to(a, cap)
+        got = np.asarray(ops.member_mask(pa, ops.pad_to(s, cap)))
+        want = np.zeros(cap, dtype=bool)
+        want[: len(a)] = ref.member_mask(a, s)
+        np.testing.assert_array_equal(got, want)
+
+
+def make_csr(rng, nrows=10, max_deg=8, max_val=100):
+    lists = [np.sort(rng.choice(max_val, size=rng.integers(0, max_deg), replace=False)).astype(np.int32)
+             for _ in range(nrows)]
+    offsets = np.zeros(nrows + 1, dtype=np.int32)
+    offsets[1:] = np.cumsum([len(l) for l in lists])
+    dst = np.concatenate(lists) if lists else np.empty(0, dtype=np.int32)
+    return offsets, dst.astype(np.int32), lists
+
+
+def test_expand_csr(rng):
+    for _ in range(15):
+        offsets, dst, lists = make_csr(rng)
+        nrows = len(lists)
+        b = rng.integers(1, 6)
+        rows = rng.integers(-1, nrows, size=b).astype(np.int32)
+        want = ref.expand_csr(offsets, dst, rows)
+        cap = ops.bucket(max(1, len(want)))
+        out, seg, total = ops.expand_csr(offsets, dst, rows, cap)
+        out, seg = np.asarray(out), np.asarray(seg)
+        assert int(total) == len(want)
+        np.testing.assert_array_equal(out[: len(want)], want)
+        assert np.all(out[len(want):] == SENT)
+        # seg maps each slot to the input position that produced it
+        want_seg = np.concatenate(
+            [np.full(len(lists[r]), i) for i, r in enumerate(rows) if r >= 0]
+            or [np.empty(0, dtype=np.int64)]
+        )
+        np.testing.assert_array_equal(seg[: len(want)], want_seg)
+        assert np.all(seg[len(want):] == -1)
+
+
+def test_expand_csr_empty_arena():
+    offsets = np.zeros(4, dtype=np.int32)
+    dst = np.empty(0, dtype=np.int32)
+    out, seg, total = ops.expand_csr(offsets, dst, np.array([0, 1, 2], np.int32), 8)
+    assert int(total) == 0
+    assert np.all(np.asarray(out) == SENT)
+    assert np.all(np.asarray(seg) == -1)
+
+
+def test_rows_of(rng):
+    src = np.unique(rng.integers(0, 100, size=20)).astype(np.int32)
+    cap = ops.bucket(len(src))
+    psrc = ops.pad_to(src, cap)
+    uids = np.array([src[0], 101, src[-1], SENT], dtype=np.int32)
+    got = np.asarray(ops.rows_of(psrc, ops.pad_to(uids[:3], 4)))
+    assert got[0] == 0
+    assert got[1] == -1
+    assert got[2] == len(src) - 1
+    assert got[3] == -1
+
+
+def test_range_rows():
+    rows, n = ops.range_rows(2, 5, 8)
+    np.testing.assert_array_equal(np.asarray(rows), [2, 3, 4, -1, -1, -1, -1, -1])
+    assert int(n) == 3
+    rows, n = ops.range_rows(0, 10, 4)  # overflow: truncated, n signals it
+    assert int(n) == 10
+    np.testing.assert_array_equal(np.asarray(rows), [0, 1, 2, 3])
