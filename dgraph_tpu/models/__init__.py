@@ -1,0 +1,14 @@
+"""Data model: value types, uid dictionary, schema state, the host posting
+store with mutation semantics, and the device-resident CSR arenas.
+
+Equivalent of the reference's posting/ + schema/ + types/ layers
+(SURVEY.md §2), re-designed so the query-time representation is a set of
+immutable, device-resident tensors ("arenas") rebuilt incrementally from
+the mutable host store — the TPU analog of posting list cache + badger.
+"""
+
+from dgraph_tpu.models.types import TypeID, TypedValue  # noqa: F401
+from dgraph_tpu.models.uids import UidMap  # noqa: F401
+from dgraph_tpu.models.schema import SchemaState, parse_schema  # noqa: F401
+from dgraph_tpu.models.store import PostingStore  # noqa: F401
+from dgraph_tpu.models.arena import ArenaManager  # noqa: F401

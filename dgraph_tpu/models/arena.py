@@ -1,0 +1,299 @@
+"""Device-resident posting-list arenas.
+
+The query-time representation of the graph: per predicate, immutable CSR
+tensors on device —
+
+- **data arena**: sorted source uids + offsets + packed sorted target uids
+  (uid predicates) — replaces the reference's per-key badger lookups +
+  posting-list iteration (posting/list.go PIterator, worker/task.go:287).
+- **reverse arena**: the inverted edge set (@reverse, posting/index.go:152).
+- **index arenas**: one per tokenizer — host-side sorted token table +
+  device CSR token-row → uid list (posting/index.go addIndexMutation:108).
+  Inequalities become contiguous token-row ranges (sortable tokenizers).
+- **value arena**: sorted uids + float32 numerics for device order-by /
+  aggregation / math; exact typed values stay on the host store.
+- count queries need no extra arena: degree = offsets diff (the reference
+  maintains a separate count index, x/keys.go:101 — dense CSR gives it
+  for free).
+
+Arenas are rebuilt per dirty predicate from the host store (the analog of
+the gentle-commit + lcache refresh cycle, posting/lists.go:109-215).
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from dgraph_tpu import ops
+from dgraph_tpu.ops.sets import SENT
+from dgraph_tpu import tok as tokmod
+from dgraph_tpu.models.store import PostingStore
+from dgraph_tpu.models.types import TypeID, TypedValue, numeric
+
+
+@dataclass
+class CSRArena:
+    """One CSR posting structure on device, with host mirrors for planning."""
+
+    src: Optional[jnp.ndarray]      # int32[Sb] sorted row-key uids; None if rows are implicit
+    offsets: jnp.ndarray            # int32[Sb+1]; padded rows have degree 0
+    dst: jnp.ndarray                # int32[Eb], SENT-padded
+    h_src: np.ndarray               # int64[S] (exact, unpadded)
+    h_offsets: np.ndarray           # int64[S+1]
+    n_rows: int
+    n_edges: int
+
+    def degree_of_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Host-side degree lookup for capacity planning."""
+        rows = np.asarray(rows)
+        ok = rows >= 0
+        r = np.where(ok, rows, 0)
+        return np.where(ok, self.h_offsets[r + 1] - self.h_offsets[r], 0)
+
+    def rows_for_uids_host(self, uids: np.ndarray) -> np.ndarray:
+        pos = np.searchsorted(self.h_src, uids)
+        pos = np.clip(pos, 0, max(0, self.n_rows - 1))
+        if self.n_rows == 0:
+            return np.full(len(uids), -1, dtype=np.int64)
+        hit = self.h_src[pos] == uids
+        return np.where(hit, pos, -1)
+
+
+def _build_csr(rows_to_dsts: Dict[int, np.ndarray]) -> CSRArena:
+    """Build a CSR arena from {row_key: array-of-dst} (host)."""
+    keys = np.array(sorted(rows_to_dsts.keys()), dtype=np.int64)
+    S = len(keys)
+    degs = np.array([len(rows_to_dsts[k]) for k in keys], dtype=np.int64)
+    offsets = np.zeros(S + 1, dtype=np.int64)
+    np.cumsum(degs, out=offsets[1:])
+    E = int(offsets[-1])
+    dst = np.empty(E, dtype=np.int32)
+    for i, k in enumerate(keys):
+        d = np.sort(np.asarray(list(rows_to_dsts[k]), dtype=np.int32))
+        dst[offsets[i] : offsets[i + 1]] = d
+    return _csr_from_arrays(keys, offsets, dst)
+
+
+def _csr_from_arrays(keys: np.ndarray, offsets: np.ndarray, dst: np.ndarray) -> CSRArena:
+    S, E = len(keys), len(dst)
+    Sb = ops.bucket(max(1, S))
+    Eb = ops.bucket(max(1, E))
+    src_pad = np.full(Sb, SENT, dtype=np.int32)
+    src_pad[:S] = keys.astype(np.int32)
+    off_pad = np.full(Sb + 1, offsets[-1] if S else 0, dtype=np.int32)
+    off_pad[: S + 1] = offsets.astype(np.int32)
+    dst_pad = np.full(Eb, SENT, dtype=np.int32)
+    dst_pad[:E] = dst
+    return CSRArena(
+        src=jnp.asarray(src_pad),
+        offsets=jnp.asarray(off_pad),
+        dst=jnp.asarray(dst_pad),
+        h_src=keys,
+        h_offsets=offsets,
+        n_rows=S,
+        n_edges=E,
+    )
+
+
+@dataclass
+class IndexArena:
+    """Secondary index: host token table + device token-row → uids CSR."""
+
+    tokenizer: str
+    tokens: list                    # sorted token keys (host)
+    csr: CSRArena                   # rows aligned with ``tokens``
+    lossy: bool
+
+    def row_of(self, token) -> int:
+        i = bisect.bisect_left(self.tokens, token)
+        if i < len(self.tokens) and self.tokens[i] == token:
+            return i
+        return -1
+
+    def row_range(self, lo=None, hi=None, lo_open=False, hi_open=False) -> Tuple[int, int]:
+        """Token rows t with lo <=(<) t <=(<) hi, as [start, end)."""
+        start = 0
+        end = len(self.tokens)
+        if lo is not None:
+            start = (
+                bisect.bisect_right(self.tokens, lo)
+                if lo_open
+                else bisect.bisect_left(self.tokens, lo)
+            )
+        if hi is not None:
+            end = (
+                bisect.bisect_left(self.tokens, hi)
+                if hi_open
+                else bisect.bisect_right(self.tokens, hi)
+            )
+        return start, max(start, end)
+
+
+@dataclass
+class ValueArena:
+    """Numeric values on device for order-by/aggregation/math."""
+
+    src: jnp.ndarray                # int32[Sb] sorted uids, SENT-padded
+    vals: jnp.ndarray               # float32[Sb]; padding slots hold NaN
+    h_src: np.ndarray               # int64[S]
+    h_vals: np.ndarray              # float64[S]
+    n: int
+
+
+class ArenaManager:
+    """Builds and caches arenas; invalidates on store dirty marks.
+
+    The analog of posting's lcache + periodicCommit (posting/lists.go):
+    arenas for clean predicates stay resident on device between queries.
+    """
+
+    def __init__(self, store: PostingStore):
+        self.store = store
+        self._data: Dict[str, CSRArena] = {}
+        self._reverse: Dict[str, CSRArena] = {}
+        self._index: Dict[Tuple[str, str], IndexArena] = {}
+        self._values: Dict[str, ValueArena] = {}
+
+    def refresh(self):
+        """Drop cached arenas for predicates mutated since last refresh."""
+        dirty = self.store.dirty
+        if not dirty:
+            return
+        for p in list(dirty):
+            for key in [k for k in self._data if k == p or k.startswith(p + "\x00")]:
+                self._data.pop(key, None)
+            self._reverse.pop(p, None)
+            self._values.pop(p, None)
+            for key in [k for k in self._index if k[0] == p]:
+                self._index.pop(key, None)
+        dirty.clear()
+
+    # -- data / reverse ----------------------------------------------------
+
+    def data(self, pred: str) -> CSRArena:
+        self.refresh()
+        a = self._data.get(pred)
+        if a is None:
+            pd = self.store.peek(pred)
+            rows: Dict[int, np.ndarray] = {}
+            if pd is not None:
+                for u, dsts in pd.edges.items():
+                    rows[u] = np.fromiter(dsts, dtype=np.int64, count=len(dsts))
+            a = _build_csr(rows)
+            self._data[pred] = a
+        return a
+
+    def has_rows(self, pred: str) -> CSRArena:
+        """Arena whose rows are every uid with *any* posting (edge or value)
+        for the predicate — serves has(pred) and _predicate_ expansion.
+        Realized as the data arena for uid preds; for value preds a CSR of
+        degree-0 rows whose row set is what matters."""
+        self.refresh()
+        pd = self.store.peek(pred)
+        if pd is None or not pd.values:
+            return self.data(pred)
+        key = pred + "\x00has"
+        a = self._data.get(key)
+        if a is None:
+            rows = {u: np.empty(0, dtype=np.int64) for u in pd.uids_with_data()}
+            for u, dsts in pd.edges.items():
+                rows[u] = np.fromiter(dsts, dtype=np.int64, count=len(dsts))
+            a = _build_csr(rows)
+            self._data[key] = a
+        return a
+
+    def reverse(self, pred: str) -> CSRArena:
+        self.refresh()
+        a = self._reverse.get(pred)
+        if a is None:
+            pd = self.store.peek(pred)
+            rows: Dict[int, list] = {}
+            if pd is not None:
+                for u, dsts in pd.edges.items():
+                    for d in dsts:
+                        rows.setdefault(d, []).append(u)
+            a = _build_csr({k: np.asarray(v, dtype=np.int64) for k, v in rows.items()})
+            self._reverse[pred] = a
+        return a
+
+    # -- secondary indexes ---------------------------------------------------
+
+    def index(self, pred: str, tokenizer: str) -> IndexArena:
+        self.refresh()
+        key = (pred, tokenizer)
+        a = self._index.get(key)
+        if a is None:
+            a = self._build_index(pred, tokenizer)
+            self._index[key] = a
+        return a
+
+    def _build_index(self, pred: str, tokenizer: str) -> IndexArena:
+        tk = tokmod.get_tokenizer(tokenizer)
+        pd = self.store.peek(pred)
+        buckets: Dict[object, set] = {}
+        if pd is not None:
+            for (uid, _lang), val in pd.values.items():
+                try:
+                    toks = tk.fn(val)
+                except (ValueError, TypeError, OverflowError):
+                    continue  # unindexable value (wrong type, inf, ...)
+                for t in toks:
+                    buckets.setdefault(t, set()).add(uid)
+        tokens = sorted(buckets.keys())
+        rows = {
+            i: np.fromiter(buckets[t], dtype=np.int64, count=len(buckets[t]))
+            for i, t in enumerate(tokens)
+        }
+        csr = _build_csr(rows)
+        # implicit rows: row i of the CSR == tokens[i]
+        csr2 = CSRArena(
+            src=None,
+            offsets=csr.offsets,
+            dst=csr.dst,
+            h_src=csr.h_src,
+            h_offsets=csr.h_offsets,
+            n_rows=csr.n_rows,
+            n_edges=csr.n_edges,
+        )
+        return IndexArena(tokenizer=tokenizer, tokens=tokens, csr=csr2, lossy=tk.lossy)
+
+    # -- numeric values ------------------------------------------------------
+
+    def values(self, pred: str) -> ValueArena:
+        self.refresh()
+        a = self._values.get(pred)
+        if a is None:
+            pd = self.store.peek(pred)
+            pairs: Dict[int, float] = {}
+            if pd is not None:
+                # Deterministic lang choice: untagged value wins, else the
+                # lexicographically first language (stable across ingest
+                # order, unlike dict iteration).
+                for (uid, lang) in sorted(pd.values.keys(), key=lambda k: (k[0], k[1] != "", k[1])):
+                    if uid in pairs:
+                        continue
+                    x = numeric(pd.values[(uid, lang)])
+                    if x is not None:
+                        pairs[uid] = x
+            uids = np.array(sorted(pairs.keys()), dtype=np.int64)
+            vals = np.array([pairs[u] for u in uids], dtype=np.float64)
+            S = len(uids)
+            Sb = ops.bucket(max(1, S))
+            su = np.full(Sb, SENT, dtype=np.int32)
+            su[:S] = uids.astype(np.int32)
+            vv = np.full(Sb, np.nan, dtype=np.float32)
+            vv[:S] = vals.astype(np.float32)
+            a = ValueArena(
+                src=jnp.asarray(su),
+                vals=jnp.asarray(vv),
+                h_src=uids,
+                h_vals=vals,
+                n=S,
+            )
+            self._values[pred] = a
+        return a

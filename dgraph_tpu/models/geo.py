@@ -1,0 +1,207 @@
+"""Geo values and grid cell indexing.
+
+The reference indexes geometries with S2 cell coverings at levels 5-16
+(types/s2index.go:42, types/earth.go) and exact-filters candidates
+(types/geofilter.go).  We use a hierarchical lat/lng quadtree grid — the
+same candidates-then-exact-filter contract, with integer cell tokens whose
+containment is prefix arithmetic (TPU/host friendly, no S2 dependency).
+
+A cell id at level L encodes the quadtree path from the root; parents are
+obtained by shifting.  index_cells emits the covering cell at each level
+in [MIN_LEVEL, MAX_LEVEL] for points; polygons contribute every cell their
+bounding box intersects at a level chosen to bound the cell count
+(analog of maxCells=18 in types/s2index.go).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+MIN_LEVEL = 5
+MAX_LEVEL = 16
+MAX_CELLS = 18
+EARTH_RADIUS_M = 6_371_000.0
+
+
+@dataclass(frozen=True)
+class Geom:
+    """Parsed geometry: a point or a polygon (lng/lat degrees, GeoJSON order)."""
+
+    kind: str  # "Point" | "Polygon"
+    coords: Tuple  # Point: (lng, lat); Polygon: tuple of (lng, lat) ring
+
+    def to_geojson(self) -> dict:
+        if self.kind == "Point":
+            return {"type": "Point", "coordinates": list(self.coords)}
+        return {"type": "Polygon", "coordinates": [[list(c) for c in self.coords]]}
+
+
+def parse_geojson(s) -> Geom:
+    obj = json.loads(s) if isinstance(s, str) else s
+    t = obj.get("type")
+    if t == "Point":
+        lng, lat = obj["coordinates"][:2]
+        return Geom("Point", (float(lng), float(lat)))
+    if t == "Polygon":
+        ring = tuple((float(c[0]), float(c[1])) for c in obj["coordinates"][0])
+        return Geom("Polygon", ring)
+    raise ValueError(f"unsupported geometry type {t!r}")
+
+
+def _cell(lng: float, lat: float, level: int) -> int:
+    """Quadtree cell id: level tag + interleaved row/col bits."""
+    n = 1 << level
+    x = min(n - 1, max(0, int((lng + 180.0) / 360.0 * n)))
+    y = min(n - 1, max(0, int((lat + 90.0) / 180.0 * n)))
+    return (level << 56) | (y << 28) | x
+
+
+def cell_parent(cell: int, level: int) -> int:
+    l = cell >> 56
+    if level > l:
+        raise ValueError("parent level above cell level")
+    shift = l - level
+    y = ((cell >> 28) & ((1 << 28) - 1)) >> shift
+    x = (cell & ((1 << 28) - 1)) >> shift
+    return (level << 56) | (y << 28) | x
+
+
+def point_cells(lng: float, lat: float) -> List[int]:
+    """All ancestor cells for a point — one per level (s2index.go
+    IndexGeoTokens indexes cover + ancestors so 'contains' queries hit)."""
+    return [_cell(lng, lat, lv) for lv in range(MIN_LEVEL, MAX_LEVEL + 1)]
+
+
+def _bbox(ring: Sequence[Tuple[float, float]]):
+    lngs = [c[0] for c in ring]
+    lats = [c[1] for c in ring]
+    return min(lngs), min(lats), max(lngs), max(lats)
+
+
+def polygon_cells(ring: Sequence[Tuple[float, float]]) -> List[int]:
+    """Covering of a polygon's bbox with at most ~MAX_CELLS cells, plus the
+    ancestors of each covering cell."""
+    lo_lng, lo_lat, hi_lng, hi_lat = _bbox(ring)
+    for level in range(MAX_LEVEL, MIN_LEVEL - 1, -1):
+        n = 1 << level
+        x0 = int((lo_lng + 180.0) / 360.0 * n)
+        x1 = int((hi_lng + 180.0) / 360.0 * n)
+        y0 = int((lo_lat + 90.0) / 180.0 * n)
+        y1 = int((hi_lat + 90.0) / 180.0 * n)
+        # At MIN_LEVEL accept the covering regardless of size so huge
+        # polygons still get indexed (the reference likewise falls back to
+        # its coarsest covering rather than dropping the geometry).
+        if (x1 - x0 + 1) * (y1 - y0 + 1) <= MAX_CELLS or level == MIN_LEVEL:
+            cover = [
+                (level << 56) | (y << 28) | x
+                for y in range(max(0, y0), min(y1, n - 1) + 1)
+                for x in range(max(0, x0), min(x1, n - 1) + 1)
+            ]
+            out = set(cover)
+            for c in cover:  # ancestors
+                for lv in range(MIN_LEVEL, level):
+                    out.add(cell_parent(c, lv))
+            return sorted(out)
+    return []
+
+
+def index_cells(g: Geom) -> List[int]:
+    if g.kind == "Point":
+        return point_cells(*g.coords)
+    return polygon_cells(g.coords)
+
+
+def query_cells(g: Geom, within: bool = False) -> List[int]:
+    """Cells to look up for a geo query (geofilter.go GetGeoTokens:71):
+    for a point query — its ancestors; for a region — its covering plus
+    ancestors (handled by polygon_cells)."""
+    return index_cells(g)
+
+
+def haversine_m(a: Tuple[float, float], b: Tuple[float, float]) -> float:
+    lng1, lat1, lng2, lat2 = map(math.radians, (*a, *b))
+    dlat, dlng = lat2 - lat1, lng2 - lng1
+    h = math.sin(dlat / 2) ** 2 + math.cos(lat1) * math.cos(lat2) * math.sin(dlng / 2) ** 2
+    return 2 * EARTH_RADIUS_M * math.asin(math.sqrt(h))
+
+
+def point_in_polygon(pt: Tuple[float, float], ring: Sequence[Tuple[float, float]]) -> bool:
+    """Ray casting, for the exact post-filter (geofilter.go MatchesFilter)."""
+    x, y = pt
+    inside = False
+    n = len(ring)
+    for i in range(n):
+        x1, y1 = ring[i]
+        x2, y2 = ring[(i + 1) % n]
+        if (y1 > y) != (y2 > y):
+            xin = (x2 - x1) * (y - y1) / (y2 - y1) + x1
+            if x < xin:
+                inside = not inside
+    return inside
+
+
+def _segs_cross(a1, a2, b1, b2) -> bool:
+    """Proper segment intersection via orientation tests."""
+
+    def orient(p, q, r):
+        v = (q[0] - p[0]) * (r[1] - p[1]) - (q[1] - p[1]) * (r[0] - p[0])
+        return 0 if v == 0 else (1 if v > 0 else -1)
+
+    o1, o2 = orient(a1, a2, b1), orient(a1, a2, b2)
+    o3, o4 = orient(b1, b2, a1), orient(b1, b2, a2)
+    if o1 != o2 and o3 != o4:
+        return True
+
+    def on_seg(p, q, r):
+        return (
+            orient(p, q, r) == 0
+            and min(p[0], q[0]) <= r[0] <= max(p[0], q[0])
+            and min(p[1], q[1]) <= r[1] <= max(p[1], q[1])
+        )
+
+    return on_seg(a1, a2, b1) or on_seg(a1, a2, b2) or on_seg(b1, b2, a1) or on_seg(b1, b2, a2)
+
+
+def _rings_cross(r1, r2) -> bool:
+    n1, n2 = len(r1), len(r2)
+    for i in range(n1):
+        for j in range(n2):
+            if _segs_cross(r1[i], r1[(i + 1) % n1], r2[j], r2[(j + 1) % n2]):
+                return True
+    return False
+
+
+def matches_filter(kind: str, query: Geom, target: Geom, max_m: Optional[float] = None) -> bool:
+    """Exact geo predicate evaluation for near/within/contains/intersects."""
+    if kind == "near":
+        if target.kind != "Point" or query.kind != "Point":
+            return False
+        return haversine_m(query.coords, target.coords) <= (max_m or 0.0)
+    if kind == "within":  # target within query polygon
+        if query.kind != "Polygon":
+            return False
+        if target.kind == "Point":
+            return point_in_polygon(target.coords, query.coords)
+        return all(point_in_polygon(c, query.coords) for c in target.coords)
+    if kind == "contains":  # target polygon contains query point
+        if target.kind != "Polygon":
+            return False
+        if query.kind == "Point":
+            return point_in_polygon(query.coords, target.coords)
+        return all(point_in_polygon(c, target.coords) for c in query.coords)
+    if kind == "intersects":
+        if target.kind == "Point" and query.kind == "Point":
+            return target.coords == query.coords
+        if target.kind == "Point":
+            return point_in_polygon(target.coords, query.coords)
+        if query.kind == "Point":
+            return point_in_polygon(query.coords, target.coords)
+        return (
+            any(point_in_polygon(c, query.coords) for c in target.coords)
+            or any(point_in_polygon(c, target.coords) for c in query.coords)
+            or _rings_cross(query.coords, target.coords)
+        )
+    raise ValueError(f"unknown geo filter {kind!r}")

@@ -1,0 +1,172 @@
+"""Host-side posting store with Set/Del mutation semantics.
+
+Equivalent of the reference's posting/ package (list.go mutation layer +
+lists.go store): the mutable source of truth that the immutable device
+arenas are built from.  The reference overlays a sorted mutation layer on
+an immutable protobuf layer per list (posting/list.go:321-410); here the
+host store is a straightforward per-predicate edge/value map with dirty
+tracking, and "commit" = rebuilding the affected predicate's arena
+(models/arena.py) — the analog of SyncIfDirty + lcache refresh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from dgraph_tpu.models.types import TypeID, TypedValue
+from dgraph_tpu.models.schema import SchemaState
+from dgraph_tpu.models.uids import UidMap
+
+
+@dataclass
+class Edge:
+    """A directed edge mutation (protos DirectedEdge, task.proto:103)."""
+
+    pred: str
+    src: int
+    dst: int = 0                      # uid edges
+    value: Optional[TypedValue] = None  # value edges
+    lang: str = ""
+    facets: Optional[Dict[str, TypedValue]] = None
+    op: str = "set"                   # "set" | "del"
+
+
+class PredicateData:
+    """All postings for one predicate: uid edges and/or values."""
+
+    __slots__ = ("edges", "values", "edge_facets", "value_facets")
+
+    def __init__(self):
+        # src uid -> set of dst uids
+        self.edges: Dict[int, Set[int]] = {}
+        # (src uid, lang) -> TypedValue ; lang "" is the default value
+        self.values: Dict[Tuple[int, str], TypedValue] = {}
+        # (src, dst) -> facets
+        self.edge_facets: Dict[Tuple[int, int], Dict[str, TypedValue]] = {}
+        # src -> facets (on value edges)
+        self.value_facets: Dict[int, Dict[str, TypedValue]] = {}
+
+    def uids_with_data(self) -> Set[int]:
+        out = set(self.edges.keys())
+        out.update(u for (u, _l) in self.values.keys())
+        return out
+
+
+class PostingStore:
+    """The mutable graph: schema + uid dictionary + per-predicate postings."""
+
+    def __init__(self, schema: Optional[SchemaState] = None):
+        self.schema = schema if schema is not None else SchemaState()
+        self.uids = UidMap()
+        self._preds: Dict[str, PredicateData] = {}
+        self.dirty: Set[str] = set()
+
+    # -- access ------------------------------------------------------------
+
+    def predicates(self) -> List[str]:
+        return sorted(self._preds)
+
+    def pred(self, name: str) -> PredicateData:
+        p = self._preds.get(name)
+        if p is None:
+            p = PredicateData()
+            self._preds[name] = p
+        return p
+
+    def peek(self, name: str) -> Optional[PredicateData]:
+        return self._preds.get(name)
+
+    def value(self, pred: str, uid: int, lang: str = "") -> Optional[TypedValue]:
+        p = self._preds.get(pred)
+        if p is None:
+            return None
+        v = p.values.get((uid, lang))
+        if v is None and lang:
+            # language fallback to the untagged value (posting/list.go:850
+            # ValueFor falls back across the lang preference list)
+            v = p.values.get((uid, ""))
+        return v
+
+    def any_value(self, pred: str, uid: int) -> Optional[TypedValue]:
+        """The untagged value, else any language's value (list.go:835)."""
+        p = self._preds.get(pred)
+        if p is None:
+            return None
+        v = p.values.get((uid, ""))
+        if v is not None:
+            return v
+        for (u, _l), val in p.values.items():
+            if u == uid:
+                return val
+        return None
+
+    def neighbors(self, pred: str, uid: int) -> List[int]:
+        p = self._preds.get(pred)
+        if p is None:
+            return []
+        return sorted(p.edges.get(uid, ()))
+
+    # -- mutation ----------------------------------------------------------
+
+    def apply(self, e: Edge) -> None:
+        """Apply one edge mutation (AddMutationWithIndex analog,
+        posting/index.go:273 — index derivation happens at arena build)."""
+        p = self.pred(e.pred)
+        self.dirty.add(e.pred)
+        if e.op == "set":
+            if e.value is not None:
+                p.values[(e.src, e.lang)] = e.value
+                if e.facets:
+                    p.value_facets[e.src] = dict(e.facets)
+            else:
+                p.edges.setdefault(e.src, set()).add(e.dst)
+                if e.facets:
+                    p.edge_facets[(e.src, e.dst)] = dict(e.facets)
+        elif e.op == "del":
+            if e.value is not None or e.dst == 0:
+                p.values.pop((e.src, e.lang), None)
+                p.value_facets.pop(e.src, None)
+            else:
+                s = p.edges.get(e.src)
+                if s is not None:
+                    s.discard(e.dst)
+                    if not s:
+                        del p.edges[e.src]
+                p.edge_facets.pop((e.src, e.dst), None)
+        else:
+            raise ValueError(f"unknown mutation op {e.op!r}")
+
+    def apply_many(self, edges: Iterable[Edge]) -> int:
+        n = 0
+        for e in edges:
+            self.apply(e)
+            n += 1
+        return n
+
+    def delete_predicate(self, pred: str) -> None:
+        """posting.DeletePredicate analog (posting/index.go:666)."""
+        self._preds.pop(pred, None)
+        self.dirty.add(pred)
+
+    def set_edge(self, pred: str, src: int, dst: int, facets=None):
+        self.apply(Edge(pred=pred, src=src, dst=dst, facets=facets))
+
+    def del_edge(self, pred: str, src: int, dst: int):
+        self.apply(Edge(pred=pred, src=src, dst=dst, op="del"))
+
+    def set_value(self, pred: str, uid: int, value: TypedValue, lang: str = "", facets=None):
+        self.apply(Edge(pred=pred, src=uid, value=value, lang=lang, facets=facets))
+
+    def del_value(self, pred: str, uid: int, lang: str = ""):
+        self.apply(
+            Edge(pred=pred, src=uid, value=TypedValue(TypeID.DEFAULT, ""), lang=lang, op="del")
+        )
+
+    # -- stats -------------------------------------------------------------
+
+    def edge_count(self) -> int:
+        return sum(
+            sum(len(s) for s in p.edges.values()) + len(p.values)
+            for p in self._preds.values()
+        )

@@ -1,0 +1,59 @@
+"""Uid dictionary: external ids ↔ dense internal int32 uids.
+
+The reference leases sparse uint64 uids from a Raft-replicated counter
+(worker/assign.go, worker/lease.go).  On TPU, 64-bit ints are emulated and
+sparse ids waste gather bandwidth, so we instead assign *dense* int32 uids
+at ingest: uid N is row N of every dense per-predicate value table.  The
+external representation (client-visible `_uid_`, RDF `<0x...>` subjects)
+remains hex of the internal id; string xids (`<name>`, `_:blank`) resolve
+through this dictionary exactly like the reference's client-side allocator
+(client/mutations.go:125).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+
+class UidMap:
+    """Monotonic allocator: xid string → dense uid, starting at 1."""
+
+    def __init__(self):
+        self._xid_to_uid: Dict[str, int] = {}
+        self._next = 1
+
+    def __len__(self) -> int:
+        return self._next - 1
+
+    @property
+    def max_uid(self) -> int:
+        return self._next - 1
+
+    def assign(self, xid: str) -> int:
+        """Get or allocate the uid for an external id."""
+        uid = self._xid_to_uid.get(xid)
+        if uid is None:
+            uid = self._next
+            self._next += 1
+            self._xid_to_uid[xid] = uid
+        return uid
+
+    def assign_many(self, xids: Iterable[str]) -> List[int]:
+        return [self.assign(x) for x in xids]
+
+    def lookup(self, xid: str) -> Optional[int]:
+        return self._xid_to_uid.get(xid)
+
+    def fresh(self, n: int = 1) -> List[int]:
+        """Allocate n anonymous uids (blank nodes without reuse)."""
+        out = list(range(self._next, self._next + n))
+        self._next += n
+        return out
+
+    def reserve_through(self, uid: int) -> None:
+        """Ensure explicit numeric uids (RDF `<0x5>`) stay allocatable."""
+        if uid >= self._next:
+            self._next = uid + 1
+
+    def snapshot(self) -> Dict[str, int]:
+        return dict(self._xid_to_uid)

@@ -11,6 +11,7 @@ from dgraph_tpu.ops.sets import (  # noqa: F401
     SENT,
     bucket,
     pad_to,
+    pad_rows,
     compact,
     sort_unique,
     intersect,

@@ -43,12 +43,18 @@ def bucket(n: int, floor: int = 8) -> int:
     return b
 
 
-def pad_to(x: np.ndarray, size: int) -> np.ndarray:
-    """Pad a host int array to ``size`` with SENT (host-side helper)."""
+def pad_to(x: np.ndarray, size: int, fill: int = SENT) -> np.ndarray:
+    """Pad a host int array to ``size`` with ``fill`` (host-side helper)."""
     x = np.asarray(x, dtype=np.int32)
-    out = np.full(size, SENT, dtype=np.int32)
+    out = np.full(size, fill, dtype=np.int32)
     out[: x.shape[0]] = x
     return out
+
+
+def pad_rows(x: np.ndarray, size: int) -> np.ndarray:
+    """Pad a host row-index array to ``size`` with -1 (the 'skip' marker
+    expand_csr expects — NOT the SENT uid sentinel)."""
+    return pad_to(x, size, fill=-1)
 
 
 @jax.jit

@@ -1,0 +1,103 @@
+"""A compact Porter-style stemmer for the fulltext tokenizer.
+
+The reference delegates to bleve's snowball stemmers (tok/fts.go:46-142).
+What matters for retrieval correctness is that index build and query use
+the *same* reduction, so a light English stemmer suffices; non-English
+languages get identity (tokens still match exactly).
+"""
+
+from __future__ import annotations
+
+_VOWELS = set("aeiou")
+
+
+def _measure(s: str) -> int:
+    """Porter's m: number of VC sequences."""
+    m, prev_v = 0, False
+    for i, c in enumerate(s):
+        v = c in _VOWELS or (c == "y" and i > 0 and s[i - 1] not in _VOWELS)
+        if prev_v and not v:
+            m += 1
+        prev_v = v
+    return m
+
+
+def _has_vowel(s: str) -> bool:
+    return any(c in _VOWELS or (c == "y" and i > 0) for i, c in enumerate(s))
+
+
+def stem(word: str, lang: str = "en") -> str:
+    if lang != "en" or len(word) <= 2:
+        return word
+    w = word
+
+    # step 1a: plurals
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith("ies"):
+        w = w[:-2]
+    elif not w.endswith("ss") and w.endswith("s"):
+        w = w[:-1]
+
+    # step 1b: -ed / -ing
+    if w.endswith("eed"):
+        if _measure(w[:-3]) > 0:
+            w = w[:-1]
+    else:
+        for suf in ("ed", "ing"):
+            if w.endswith(suf) and _has_vowel(w[: -len(suf)]):
+                w = w[: -len(suf)]
+                if w.endswith(("at", "bl", "iz")):
+                    w += "e"
+                elif (
+                    len(w) >= 2
+                    and w[-1] == w[-2]
+                    and w[-1] not in "lsz"
+                    and w[-1] not in _VOWELS
+                ):
+                    w = w[:-1]
+                elif _measure(w) == 1 and len(w) >= 3 and w[-1] not in _VOWELS and w[-2] in _VOWELS and w[-3] not in _VOWELS and w[-1] not in "wxy":
+                    w += "e"
+                break
+
+    # step 1c: y -> i
+    if w.endswith("y") and _has_vowel(w[:-1]):
+        w = w[:-1] + "i"
+
+    # step 2/3 (common suffix map, m>0)
+    for suf, rep in (
+        ("ational", "ate"), ("tional", "tion"), ("enci", "ence"),
+        ("anci", "ance"), ("izer", "ize"), ("abli", "able"), ("alli", "al"),
+        ("entli", "ent"), ("eli", "e"), ("ousli", "ous"), ("ization", "ize"),
+        ("ation", "ate"), ("ator", "ate"), ("alism", "al"), ("iveness", "ive"),
+        ("fulness", "ful"), ("ousness", "ous"), ("aliti", "al"),
+        ("iviti", "ive"), ("biliti", "ble"), ("icate", "ic"), ("ative", ""),
+        ("alize", "al"), ("iciti", "ic"), ("ical", "ic"), ("ful", ""),
+        ("ness", ""),
+    ):
+        if w.endswith(suf):
+            base = w[: -len(suf)]
+            if _measure(base) > 0:
+                w = base + rep
+            break
+
+    # step 4 (m>1 suffix deletion)
+    for suf in (
+        "ement", "ance", "ence", "able", "ible", "ant", "ent", "ism", "ate",
+        "iti", "ous", "ive", "ize", "ment", "ion", "al", "er", "ic", "ou",
+    ):
+        if w.endswith(suf):
+            base = w[: -len(suf)]
+            if _measure(base) > 1:
+                if suf == "ion" and base and base[-1] not in "st":
+                    break
+                w = base
+            break
+
+    # step 5
+    if w.endswith("e"):
+        if _measure(w[:-1]) > 1:
+            w = w[:-1]
+    if len(w) >= 2 and w[-1] == "l" and w[-2] == "l" and _measure(w) > 1:
+        w = w[:-1]
+    return w

@@ -1,0 +1,25 @@
+"""Stopword lists for the fulltext tokenizer (analog of tok/stopwords.go,
+which bundles bleve's per-language lists; we ship English and a small set
+for common languages — unknown languages fall back to English)."""
+
+STOPWORDS = {
+    "en": frozenset(
+        """a an and are as at be but by for if in into is it no not of on
+        or such that the their then there these they this to was will with
+        i me my we our you your he him his she her its them what which who
+        whom am been being have has had having do does did doing would
+        should could can cannot don t s""".split()
+    ),
+    "de": frozenset(
+        """der die das ein eine und oder aber nicht mit von zu im in auf
+        für ist sind war waren sein als auch an bei nach über um aus""".split()
+    ),
+    "fr": frozenset(
+        """le la les un une des et ou mais ne pas avec de du au aux est
+        sont était dans sur pour par ce cette ces il elle ils elles""".split()
+    ),
+    "es": frozenset(
+        """el la los las un una unos unas y o pero no con de del al es son
+        era en sobre para por este esta estos estas él ella ellos""".split()
+    ),
+}

@@ -1,0 +1,202 @@
+"""Store / schema / arena / tokenizer tests (mirrors posting/ + schema/ +
+tok/ unit tests in the reference)."""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu import ops
+from dgraph_tpu.ops import SENT
+from dgraph_tpu.models import (
+    ArenaManager,
+    PostingStore,
+    SchemaState,
+    TypedValue,
+    parse_schema,
+)
+from dgraph_tpu.models.types import TypeID, compare_vals, convert
+from dgraph_tpu import tok
+
+
+def unpad(x):
+    x = np.asarray(x)
+    return x[x != SENT]
+
+
+def test_schema_parse_roundtrip():
+    text = """
+    name: string @index(term, exact) .
+    age: int @index(int) .
+    friend: uid @reverse @count .
+    loc: geo @index(geo) .
+    dob: datetime @index(year) .
+    """
+    s = parse_schema(text)
+    assert s.type_of("name") == TypeID.STRING
+    assert s.tokenizers("name") == ["term", "exact"]
+    assert s.has_reverse("friend")
+    assert s.has_count("friend")
+    assert s.sortable_tokenizer("age") == "int"
+    assert s.sortable_tokenizer("name") == "exact"
+    # default tokenizer selection with bare @index
+    s2 = parse_schema("age: int @index .")
+    assert s2.tokenizers("age") == ["int"]
+    # type mismatch rejected
+    with pytest.raises(ValueError):
+        parse_schema("age: int @index(term) .")
+    # @reverse requires uid
+    with pytest.raises(ValueError):
+        parse_schema("name: string @reverse .")
+    # roundtrip through text form
+    s3 = parse_schema(s.to_text())
+    assert s3.to_text() == s.to_text()
+
+
+def test_conversion_and_compare():
+    v = TypedValue(TypeID.STRING, "42")
+    assert convert(v, TypeID.INT).value == 42
+    assert convert(TypedValue(TypeID.INT, 3), TypeID.FLOAT).value == 3.0
+    assert compare_vals("lt", TypedValue(TypeID.INT, 3), TypedValue(TypeID.FLOAT, 3.5))
+    assert compare_vals("eq", TypedValue(TypeID.STRING, "a"), TypedValue(TypeID.STRING, "a"))
+    d = convert(TypedValue(TypeID.STRING, "1987-06-13"), TypeID.DATETIME)
+    assert d.value.year == 1987
+
+
+def test_store_mutation_semantics():
+    st = PostingStore()
+    st.set_edge("friend", 1, 2)
+    st.set_edge("friend", 1, 3)
+    st.set_edge("friend", 2, 3)
+    assert st.neighbors("friend", 1) == [2, 3]
+    st.del_edge("friend", 1, 2)
+    assert st.neighbors("friend", 1) == [3]
+    # set after del restores
+    st.set_edge("friend", 1, 2)
+    assert st.neighbors("friend", 1) == [2, 3]
+    st.set_value("name", 1, TypedValue(TypeID.STRING, "alice"))
+    st.set_value("name", 1, TypedValue(TypeID.STRING, "alicia"), lang="es")
+    assert st.value("name", 1).value == "alice"
+    assert st.value("name", 1, "es").value == "alicia"
+    assert st.value("name", 1, "fr").value == "alice"  # lang fallback
+    st.del_value("name", 1)
+    assert st.value("name", 1) is None
+    assert st.value("name", 1, "es").value == "alicia"
+
+
+def build_small_store():
+    st = PostingStore(parse_schema("""
+    name: string @index(term, exact) .
+    age: int @index(int) .
+    friend: uid @reverse .
+    """))
+    people = {"alice": 30, "bob": 25, "carol": 35, "dan": 25}
+    uids = {}
+    for name, age in people.items():
+        u = st.uids.assign(name)
+        uids[name] = u
+        st.set_value("name", u, TypedValue(TypeID.STRING, name.capitalize()))
+        st.set_value("age", u, TypedValue(TypeID.INT, age))
+    st.set_edge("friend", uids["alice"], uids["bob"])
+    st.set_edge("friend", uids["alice"], uids["carol"])
+    st.set_edge("friend", uids["bob"], uids["dan"])
+    st.set_edge("friend", uids["carol"], uids["dan"])
+    return st, uids
+
+
+def test_data_arena_expand():
+    st, uids = build_small_store()
+    am = ArenaManager(st)
+    a = am.data("friend")
+    assert a.n_rows == 3 and a.n_edges == 4
+    rows = ops.rows_of(a.src, ops.pad_to([uids["alice"], uids["bob"]], 4))
+    out, seg, total = ops.expand_csr(a.offsets, a.dst, rows, 8)
+    assert int(total) == 3
+    got = sorted(unpad(out).tolist())
+    assert got == sorted([uids["bob"], uids["carol"], uids["dan"]])
+
+
+def test_reverse_arena():
+    st, uids = build_small_store()
+    am = ArenaManager(st)
+    r = am.reverse("friend")
+    # who points at dan?
+    rows = r.rows_for_uids_host(np.array([uids["dan"]]))
+    assert rows[0] >= 0
+    out, _, total = ops.expand_csr(
+        r.offsets, r.dst, ops.pad_rows(rows, 4), 8
+    )
+    assert sorted(unpad(out).tolist()) == sorted([uids["bob"], uids["carol"]])
+
+
+def test_index_arena_term_and_int():
+    st, uids = build_small_store()
+    am = ArenaManager(st)
+    # exact index on name
+    idx = am.index("name", "exact")
+    row = idx.row_of("Alice")
+    assert row >= 0
+    rows, n = ops.range_rows(row, row + 1, 4)
+    out, _, _ = ops.expand_csr(idx.csr.offsets, idx.csr.dst, rows, 8)
+    assert unpad(out).tolist() == [uids["alice"]]
+    # int index range: age >= 30
+    iidx = am.index("age", "int")
+    lo, hi = iidx.row_range(lo=30)
+    rows, n = ops.range_rows(lo, hi, ops.bucket(max(1, hi - lo)))
+    cap = ops.bucket(max(1, int(iidx.csr.degree_of_rows(np.arange(lo, hi)).sum())))
+    out, _, _ = ops.expand_csr(iidx.csr.offsets, iidx.csr.dst, rows, cap)
+    got = sorted(unpad(np.asarray(ops.sort_unique(out))).tolist())
+    assert got == sorted([uids["alice"], uids["carol"]])
+    # age == 25 via exact row
+    row = iidx.row_of(25)
+    rows, _ = ops.range_rows(row, row + 1, 4)
+    out, _, _ = ops.expand_csr(iidx.csr.offsets, iidx.csr.dst, rows, 8)
+    assert sorted(unpad(out).tolist()) == sorted([uids["bob"], uids["dan"]])
+
+
+def test_value_arena_and_dirty_refresh():
+    st, uids = build_small_store()
+    am = ArenaManager(st)
+    va = am.values("age")
+    assert va.n == 4
+    i = np.searchsorted(va.h_src, uids["carol"])
+    assert va.h_vals[i] == 35.0
+    # mutation dirties and rebuilds
+    st.set_value("age", uids["carol"], TypedValue(TypeID.INT, 36))
+    va2 = am.values("age")
+    i = np.searchsorted(va2.h_src, uids["carol"])
+    assert va2.h_vals[i] == 36.0
+    # data arena also refreshed on edge mutation
+    a1 = am.data("friend")
+    st.set_edge("friend", uids["dan"], uids["alice"])
+    a2 = am.data("friend")
+    assert a2.n_edges == a1.n_edges + 1
+
+
+def test_tokenizers():
+    assert tok.term_tokens("The QUICK brown-fox, the!") == ["brown", "fox", "quick", "the"]
+    ft = tok.fulltext_tokens("The running foxes are quick")
+    assert "the" not in ft and "are" not in ft
+    assert any(t.startswith("run") for t in ft)
+    assert any(t.startswith("fox") for t in ft)
+    assert tok.trigram_tokens("abcd") == ["abc", "bcd"]
+    assert tok.tokens_for_value("int", TypedValue(TypeID.INT, 7)) == [7]
+    y = tok.tokens_for_value("year", TypedValue(TypeID.STRING, "1987-06-13"))
+    assert y == [1987]
+
+
+def test_geo_cells():
+    from dgraph_tpu.models import geo
+
+    g = geo.parse_geojson('{"type":"Point","coordinates":[-122.4,37.77]}')
+    cells = geo.index_cells(g)
+    assert len(cells) == geo.MAX_LEVEL - geo.MIN_LEVEL + 1
+    # a nearby point shares coarse ancestors
+    g2 = geo.parse_geojson('{"type":"Point","coordinates":[-122.41,37.78]}')
+    shared = set(cells) & set(geo.index_cells(g2))
+    assert shared
+    # a polygon covering SF contains the point's cells at overlapping levels
+    poly = geo.parse_geojson(
+        '{"type":"Polygon","coordinates":[[[-123,37],[-122,37],[-122,38],[-123,38],[-123,37]]]}'
+    )
+    assert geo.matches_filter("within", poly, g)
+    assert geo.matches_filter("near", g, g2, max_m=2000)
+    assert not geo.matches_filter("near", g, g2, max_m=10)
