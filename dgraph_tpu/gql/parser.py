@@ -1,0 +1,947 @@
+"""Recursive-descent parser for GraphQL±.
+
+Accepts the language of the reference's gql.Parse (gql/parser.go:481):
+named/anonymous query blocks, root functions and ``id:`` lists, filters
+with AND/OR/NOT, pagination/order args, aliases, language tags, variables
+(``x as pred``), value/uid var usage, aggregations, math(), expand(),
+count blocks, @facets, @groupby, @normalize/@cascade/@ignorereflex,
+GraphQL query variables ($var), fragments, mutation blocks and schema
+blocks.  The HTTP JSON wrapper {"query":..., "variables":...} is also
+handled here (reference does this under Request.Http).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from dgraph_tpu.gql.ast import (
+    FacetsSpec,
+    FilterTree,
+    Function,
+    GraphQuery,
+    MathTree,
+    Mutation,
+    ParsedResult,
+    SchemaRequest,
+    VarRef,
+    UID_VAR,
+    VALUE_VAR,
+)
+
+
+class ParseError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Lexer
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<comment>\#[^\n]*)
+  | (?P<string>"(?:\\.|[^"\\])*")
+  | (?P<iri><[^>\s]+>)
+  | (?P<number>0[xX][0-9a-fA-F]+|\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)
+  | (?P<name>[A-Za-z_][A-Za-z0-9_.]*)
+  | (?P<dollar>\$[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<spread>\.\.\.)
+  | (?P<op><=|>=|==|!=|&&|\|\||=|[-+*/%<>])
+  | (?P<punct>[{}()\[\]:,@!])
+    """,
+    re.VERBOSE,
+)
+
+
+class Tok:
+    __slots__ = ("kind", "text", "pos")
+
+    def __init__(self, kind, text, pos):
+        self.kind, self.text, self.pos = kind, text, pos
+
+    def __repr__(self):  # pragma: no cover
+        return f"Tok({self.kind},{self.text!r})"
+
+
+def _lex(s: str) -> List[Tok]:
+    out, i = [], 0
+    n = len(s)
+    while i < n:
+        m = _TOKEN_RE.match(s, i)
+        if m is None:
+            raise ParseError(f"unexpected character {s[i]!r} at offset {i}")
+        i = m.end()
+        kind = m.lastgroup
+        if kind in ("ws", "comment"):
+            continue
+        out.append(Tok(kind, m.group(), m.start()))
+    out.append(Tok("eof", "", n))
+    return out
+
+
+def _unquote(s: str) -> str:
+    body = s[1:-1]
+    return re.sub(
+        r"\\(.)",
+        lambda m: {"n": "\n", "t": "\t", '"': '"', "\\": "\\", "'": "'"}.get(
+            m.group(1), m.group(1)
+        ),
+        body,
+    )
+
+
+_DIRECTIVES = {
+    "filter",
+    "facets",
+    "groupby",
+    "normalize",
+    "cascade",
+    "ignorereflex",
+    "recurse",
+}
+
+_AGG_FUNCS = {"min", "max", "sum", "avg"}
+
+_ROOT_ARGS = {
+    "first",
+    "offset",
+    "after",
+    "orderasc",
+    "orderdesc",
+    "depth",
+    "from",
+    "to",
+    "numpaths",
+    "minweight",
+    "maxweight",
+}
+
+
+class _Parser:
+    def __init__(self, toks: List[Tok], gqlvars: Dict[str, str]):
+        self.toks = toks
+        self.i = 0
+        self.vars = gqlvars
+        self.fragments: Dict[str, List[GraphQuery]] = {}
+
+    # -- token plumbing ----------------------------------------------------
+
+    def peek(self, ahead: int = 0) -> Tok:
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Tok:
+        t = self.toks[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def expect(self, kind: str, text: Optional[str] = None) -> Tok:
+        t = self.next()
+        if t.kind != kind or (text is not None and t.text != text):
+            raise ParseError(
+                f"expected {text or kind} at offset {t.pos}, got {t.text!r}"
+            )
+        return t
+
+    def accept(self, kind: str, text: Optional[str] = None) -> Optional[Tok]:
+        t = self.peek()
+        if t.kind == kind and (text is None or t.text == text):
+            return self.next()
+        return None
+
+    def _value_token(self) -> str:
+        """One scalar argument value, with $var substitution."""
+        t = self.next()
+        if t.kind == "op" and t.text in ("-", "+"):
+            num = self.expect("number")
+            return t.text + num.text
+        if t.kind == "string":
+            return _unquote(t.text)
+        if t.kind == "dollar":
+            if t.text not in self.vars:
+                raise ParseError(f"undefined query variable {t.text}")
+            return self.vars[t.text]
+        if t.kind in ("name", "number", "iri"):
+            return t.text.strip("<>") if t.kind == "iri" else t.text
+        raise ParseError(f"expected value at offset {t.pos}, got {t.text!r}")
+
+    # -- entry -------------------------------------------------------------
+
+    def parse(self) -> ParsedResult:
+        res = ParsedResult()
+        while True:
+            t = self.peek()
+            if t.kind == "eof":
+                break
+            if t.kind == "punct" and t.text == "{":
+                self._parse_query_body(res)
+            elif t.kind == "name" and t.text == "query":
+                self.next()
+                if self.peek().text == "(":
+                    self._parse_var_decls()
+                if self.peek().kind == "name":  # named query: query name(...)
+                    self.next()
+                    if self.peek().text == "(":
+                        self._parse_var_decls()
+                self._parse_query_body(res)
+            elif t.kind == "name" and t.text == "schema":
+                self.next()
+                res.schema_request = self._parse_schema_request()
+            elif t.kind == "name" and t.text == "fragment":
+                self.next()
+                name = self.expect("name").text
+                self.expect("punct", "{")
+                self.fragments[name] = self._parse_children()
+            else:
+                raise ParseError(f"unexpected {t.text!r} at offset {t.pos}")
+        self._expand_fragments_all(res)
+        self._collect_query_vars(res)
+        return res
+
+    def _parse_var_decls(self):
+        """query name($a: int = 3, $b: string!) — fills defaults into vars."""
+        self.expect("punct", "(")
+        while not self.accept("punct", ")"):
+            d = self.expect("dollar").text
+            self.expect("punct", ":")
+            self.expect("name")  # type
+            self.accept("punct", "!")
+            if self.accept("op", "="):
+                self.vars.setdefault(d, self._value_token())
+            self.accept("punct", ",")
+
+    # -- query blocks ------------------------------------------------------
+
+    def _parse_query_body(self, res: ParsedResult):
+        self.expect("punct", "{")
+        while not self.accept("punct", "}"):
+            self.accept("punct", ",")
+            res.queries.append(self._parse_block())
+
+    def _parse_block(self) -> GraphQuery:
+        gq = GraphQuery()
+        name_tok = self.expect("name")
+        name = name_tok.text
+        var_def = ""
+        if self.peek().kind == "name" and self.peek().text.lower() == "as":
+            # "X as shortest(...)" / var-block named by a variable
+            self.next()
+            var_def = name
+            name = self.expect("name").text
+        gq.alias = name
+        gq.var = var_def
+        if name == "var":
+            gq.is_internal = True
+        self._parse_root_args(gq)
+        self._parse_directives(gq)
+        self.expect("punct", "{")
+        gq.children = self._parse_children()
+        return gq
+
+    def _parse_root_args(self, gq: GraphQuery):
+        if not self.accept("punct", "("):
+            return
+        while not self.accept("punct", ")"):
+            self.accept("punct", ",")
+            if self.peek().text == ")":
+                continue
+            key = self.expect("name").text
+            self.expect("punct", ":")
+            if key == "func":
+                gq.func = self._parse_function()
+            elif key == "id":
+                self._parse_id_arg(gq)
+            elif key in _ROOT_ARGS:
+                if (
+                    key in ("orderasc", "orderdesc")
+                    and self.peek().kind == "name"
+                    and self.peek().text == "val"
+                    and self.peek(1).text == "("
+                ):
+                    self.next()
+                    self.expect("punct", "(")
+                    v = self.expect("name").text
+                    self.expect("punct", ")")
+                    gq.args[key] = "val:" + v
+                    gq.needs_var.append(VarRef(v, VALUE_VAR))
+                else:
+                    v = self._value_token()
+                    if key in ("orderasc", "orderdesc"):
+                        while self.accept("punct", "@"):
+                            v += "@" + self.expect("name").text
+                    gq.args[key] = v
+            else:
+                # unknown args are ignored (reference ignores xid:, etc.)
+                self._value_token()
+
+    def _parse_id_arg(self, gq: GraphQuery):
+        """id: 0x0a | id: [1, 2, 0x3] — sugar for root uid list."""
+        if self.accept("punct", "["):
+            while not self.accept("punct", "]"):
+                self.accept("punct", ",")
+                if self.peek().text == "]":
+                    continue
+                gq.uid_list.append(_parse_uid(self._value_token()))
+        else:
+            v = self._value_token()
+            gq.uid_list.append(_parse_uid(v))
+
+    # -- functions ---------------------------------------------------------
+
+    def _parse_function(self) -> Function:
+        fn = Function()
+        fn.name = self.expect("name").text.lower()
+        self.expect("punct", "(")
+        if fn.name == "uid":
+            while not self.accept("punct", ")"):
+                self.accept("punct", ",")
+                if self.peek().text == ")":
+                    continue
+                t = self.next()
+                if t.kind == "number" or (t.kind == "name" and _is_uid(t.text)):
+                    fn.uid_args.append(_parse_uid(t.text))
+                elif t.kind == "name":
+                    fn.needs_vars.append(VarRef(t.text, UID_VAR))
+                elif t.kind == "dollar":
+                    if t.text not in self.vars:
+                        raise ParseError(f"undefined query variable {t.text}")
+                    fn.uid_args.append(_parse_uid(self.vars[t.text]))
+                else:
+                    raise ParseError(f"bad uid() arg {t.text!r}")
+            return fn
+        # first argument: attr | attr@lang | val(v) | count(attr)
+        t = self.next()
+        if t.kind == "name" and t.text == "val" and self.peek().text == "(":
+            self.expect("punct", "(")
+            v = self.expect("name").text
+            self.expect("punct", ")")
+            fn.is_val_var = True
+            fn.attr = v
+            fn.needs_vars.append(VarRef(v, VALUE_VAR))
+        elif t.kind == "name" and t.text == "count" and self.peek().text == "(":
+            self.expect("punct", "(")
+            fn.is_count = True
+            fn.attr = self.expect("name").text
+            self.expect("punct", ")")
+        elif t.kind in ("name", "iri"):
+            fn.attr = t.text.strip("<>") if t.kind == "iri" else t.text
+            while self.accept("punct", "@"):
+                lang = self.expect("name").text
+                fn.lang = lang if not fn.lang else fn.lang + "," + lang
+        else:
+            raise ParseError(f"bad function first arg {t.text!r}")
+        # remaining args
+        while not self.accept("punct", ")"):
+            self.accept("punct", ",")
+            if self.peek().text == ")":
+                continue
+            if self.peek().text == "[":
+                fn.args.append(self._parse_bracket_list())
+            elif (
+                self.peek().kind == "name"
+                and self.peek().text == "val"
+                and self.peek(1).text == "("
+            ):
+                self.next()
+                self.expect("punct", "(")
+                v = self.expect("name").text
+                self.expect("punct", ")")
+                # note: is_val_var stays false — that flag means the FIRST
+                # arg is val(var); a val() comparand is carried in args
+                fn.needs_vars.append(VarRef(v, VALUE_VAR))
+                fn.args.append("val(" + v + ")")
+            else:
+                fn.args.append(self._value_token())
+        return fn
+
+    def _parse_bracket_list(self) -> str:
+        """Geo coordinate lists: returned as a JSON string."""
+
+        def rec():
+            self.expect("punct", "[")
+            out = []
+            while not self.accept("punct", "]"):
+                self.accept("punct", ",")
+                if self.peek().text == "]":
+                    continue
+                if self.peek().text == "[":
+                    out.append(rec())
+                else:
+                    v = self._value_token()
+                    try:
+                        out.append(float(v))
+                    except ValueError:
+                        out.append(v)
+            return out
+
+        return json.dumps(rec())
+
+    # -- filters -----------------------------------------------------------
+
+    def _parse_filter(self) -> Optional[FilterTree]:
+        self.expect("punct", "(")
+        if self.accept("punct", ")"):
+            return None
+        tree = self._parse_filter_or()
+        self.expect("punct", ")")
+        return tree
+
+    def _parse_filter_or(self) -> FilterTree:
+        left = self._parse_filter_and()
+        while self.peek().kind == "name" and self.peek().text.lower() == "or":
+            self.next()
+            right = self._parse_filter_and()
+            if left.op == "or":
+                left.children.append(right)
+            else:
+                left = FilterTree(op="or", children=[left, right])
+        return left
+
+    def _parse_filter_and(self) -> FilterTree:
+        left = self._parse_filter_not()
+        while self.peek().kind == "name" and self.peek().text.lower() == "and":
+            self.next()
+            right = self._parse_filter_not()
+            if left.op == "and":
+                left.children.append(right)
+            else:
+                left = FilterTree(op="and", children=[left, right])
+        return left
+
+    def _parse_filter_not(self) -> FilterTree:
+        if self.peek().kind == "name" and self.peek().text.lower() == "not":
+            self.next()
+            return FilterTree(op="not", children=[self._parse_filter_not()])
+        if self.accept("punct", "("):
+            t = self._parse_filter_or()
+            self.expect("punct", ")")
+            return t
+        return FilterTree(func=self._parse_function())
+
+    # -- directives --------------------------------------------------------
+
+    def _parse_directives(self, gq: GraphQuery):
+        while True:
+            t = self.peek()
+            if not (t.kind == "punct" and t.text == "@"):
+                return
+            nxt = self.peek(1)
+            if nxt.kind != "name":
+                return
+            d = nxt.text.lower()
+            if d not in _DIRECTIVES:
+                return
+            self.next()
+            self.next()
+            if d == "filter":
+                gq.filter = self._parse_filter()
+            elif d == "normalize":
+                gq.normalize = True
+            elif d == "cascade":
+                gq.cascade = True
+            elif d == "ignorereflex":
+                gq.ignore_reflex = True
+            elif d == "groupby":
+                gq.is_groupby = True
+                self.expect("punct", "(")
+                while not self.accept("punct", ")"):
+                    self.accept("punct", ",")
+                    if self.peek().text == ")":
+                        continue
+                    attr = self.expect("name").text
+                    lang = ""
+                    while self.accept("punct", "@"):
+                        lang = self.expect("name").text
+                    gq.groupby_attrs.append((attr, lang))
+            elif d == "facets":
+                self._parse_facets(gq)
+            elif d == "recurse":
+                # modern-style @recurse(depth: n) — also accepted alongside
+                # the v0.7 "recurse(func:...)" block-name form
+                gq.args["recurse"] = "true"
+                if self.accept("punct", "("):
+                    while not self.accept("punct", ")"):
+                        self.accept("punct", ",")
+                        if self.peek().text == ")":
+                            continue
+                        k = self.expect("name").text
+                        self.expect("punct", ":")
+                        gq.args[k] = self._value_token()
+
+    def _parse_facets(self, gq: GraphQuery):
+        spec = gq.facets or FacetsSpec()
+        if not self.accept("punct", "("):
+            spec.all_keys = True
+            gq.facets = spec
+            return
+        if self.accept("punct", ")"):
+            spec.all_keys = True
+            gq.facets = spec
+            return
+        first = True
+        while True:
+            if not first:
+                if not self.accept("punct", ","):
+                    break
+                if self.peek().text == ")":
+                    raise ParseError("trailing comma in @facets")
+            first = False
+            t = self.peek()
+            if t.kind == "name" and t.text in ("orderasc", "orderdesc") and self.peek(1).text == ":":
+                self.next()
+                self.expect("punct", ":")
+                if spec.order_key:
+                    raise ParseError("only one facet order allowed")
+                spec.order_key = self.expect("name").text
+                spec.order_desc = t.text == "orderdesc"
+            elif t.kind == "name":
+                # facet key, possibly "v as key", possibly a filter function
+                if self.peek(1).kind == "name" and self.peek(1).text.lower() == "as":
+                    v = self.next().text
+                    self.next()
+                    key = self.expect("name").text
+                    spec.keys.append(key)
+                    spec.aliases[key] = v
+                elif self.peek(1).text == "(":
+                    # facet filter tree: @facets(eq(close, true))
+                    gq.facets_filter = self._parse_filter_or()
+                    break
+                else:
+                    key = self.next().text
+                    if key in spec.keys:
+                        raise ParseError(f"duplicate facet key {key}")
+                    spec.keys.append(key)
+            else:
+                raise ParseError(f"bad @facets content at {t.text!r}")
+        self.expect("punct", ")")
+        gq.facets = spec
+
+    # -- children ----------------------------------------------------------
+
+    def _parse_children(self) -> List[GraphQuery]:
+        out: List[GraphQuery] = []
+        while not self.accept("punct", "}"):
+            self.accept("punct", ",")
+            if self.peek().text == "}":
+                continue
+            if self.accept("spread"):
+                name = self.expect("name").text
+                ph = GraphQuery(attr="...fragment", alias=name)
+                out.append(ph)
+                continue
+            out.append(self._parse_child())
+        return out
+
+    def _parse_child(self) -> GraphQuery:
+        gq = GraphQuery()
+        # optional alias prefix: "alias: <anything>", including aliased
+        # count()/math()/val() forms ("total: count(friends)")
+        if (
+            self.peek().kind == "name"
+            and self.peek(1).kind == "punct"
+            and self.peek(1).text == ":"
+            and self.peek(2).kind in ("name", "iri")
+        ):
+            gq.alias = self.next().text
+            self.next()
+        t = self.next()
+        if t.kind == "iri":
+            gq.attr = t.text.strip("<>")
+            if self.peek().text == "(":
+                self._parse_root_args(gq)
+            self._parse_directives(gq)
+            if self.accept("punct", "{"):
+                gq.children = self._parse_children()
+            return gq
+        if t.kind != "name":
+            raise ParseError(f"expected attribute at offset {t.pos}, got {t.text!r}")
+        name = t.text
+
+        # "x as ..." variable definition
+        if self.peek().kind == "name" and self.peek().text.lower() == "as":
+            self.next()
+            gq.var = name
+            t = self.expect("name")
+            name = t.text
+
+        low = name.lower()
+        if low == "count" and self.peek().text == "(":
+            self.expect("punct", "(")
+            inner = self.expect("name").text
+            if inner == "var" or inner == "val":
+                raise ParseError("count(val()) is not allowed")
+            gq.attr = inner
+            gq.is_count = True
+            while self.accept("punct", "@"):
+                gq.langs.append(self.expect("name").text)
+            self.expect("punct", ")")
+        elif low in _AGG_FUNCS and self.peek().text == "(":
+            self.expect("punct", "(")
+            self.expect("name", "val")
+            self.expect("punct", "(")
+            v = self.expect("name").text
+            self.expect("punct", ")")
+            self.expect("punct", ")")
+            gq.attr = "val"
+            gq.agg_func = low
+            gq.needs_var.append(VarRef(v, VALUE_VAR))
+            gq.is_internal = not bool(gq.var)
+        elif low == "val" and self.peek().text == "(":
+            self.expect("punct", "(")
+            v = self.expect("name").text
+            self.expect("punct", ")")
+            gq.attr = "val"
+            gq.needs_var.append(VarRef(v, VALUE_VAR))
+        elif low == "math" and self.peek().text == "(":
+            gq.attr = "math"
+            gq.math_exp = self._parse_math()
+            gq.is_internal = not bool(gq.var)
+        elif low == "expand" and self.peek().text == "(":
+            self.expect("punct", "(")
+            inner = self.expect("name").text
+            if inner == "_all_":
+                gq.expand = "_all_"
+            elif inner == "val":
+                self.expect("punct", "(")
+                v = self.expect("name").text
+                self.expect("punct", ")")
+                gq.expand = v
+                gq.needs_var.append(VarRef(v, VALUE_VAR))
+            else:
+                raise ParseError(f"bad expand() arg {inner!r}")
+            self.expect("punct", ")")
+            gq.attr = "expand"
+        elif low == "checkpwd" and self.peek().text == "(":
+            self.expect("punct", "(")
+            gq.attr = self.expect("name").text
+            self.accept("punct", ",")
+            pwd = self._value_token()
+            self.expect("punct", ")")
+            f = Function(name="checkpwd", attr=gq.attr, args=[pwd])
+            gq.func = f
+        else:
+            gq.attr = name
+            while self.peek().kind == "punct" and self.peek().text == "@":
+                nxt = self.peek(1)
+                if nxt.kind == "name" and nxt.text.lower() in _DIRECTIVES:
+                    break
+                self.next()
+                gq.langs.append(self.expect("name").text)
+
+        # (args) — pagination/order on the edge
+        if self.peek().text == "(":
+            self._parse_root_args(gq)
+        self._parse_directives(gq)
+        if self.accept("punct", "{"):
+            gq.children = self._parse_children()
+        return gq
+
+    # -- math --------------------------------------------------------------
+
+    _MATH_FUNCS = {
+        "exp", "ln", "sqrt", "floor", "ceil", "since", "pow", "logbase",
+        "max", "min", "cond",
+    }
+
+    def _parse_math(self) -> MathTree:
+        self.expect("punct", "(")
+        tree = self._math_expr(0)
+        self.expect("punct", ")")
+        return tree
+
+    # Binary operator precedences — the reference's exact (all-distinct)
+    # table (gql/parser.go:156 mathOpPrecedence), which with left
+    # associativity reproduces its shunting-yard groupings, e.g.
+    # "a + b*c/a + e - l" ⇒ (+ (+ a (* b (/ c a))) (- e l)).
+    _BINOPS = {
+        "/": 50, "*": 49, "%": 48, "-": 47, "+": 46,
+        "<": 10, ">": 9, "<=": 8, ">=": 7, "==": 6, "!=": 5,
+        "&&": 3, "and": 3, "||": 2, "or": 2,
+    }
+
+    def _math_expr(self, min_prec: int) -> MathTree:
+        left = self._math_atom()
+        while True:
+            t = self.peek()
+            op = t.text.lower() if t.kind in ("op", "name") else None
+            if op not in self._BINOPS or self._BINOPS[op] < min_prec:
+                return left
+            self.next()
+            right = self._math_expr(self._BINOPS[op] + 1)
+            left = MathTree(fn=t.text if t.kind == "op" else op, children=[left, right])
+
+    def _math_atom(self) -> MathTree:
+        t = self.peek()
+        if t.kind == "punct" and t.text == "(":
+            self.next()
+            e = self._math_expr(0)
+            self.expect("punct", ")")
+            return e
+        if t.kind == "op" and t.text == "-":
+            self.next()
+            return MathTree(fn="u-", children=[self._math_atom()])
+        if t.kind == "number":
+            self.next()
+            return MathTree(const=float(t.text))
+        if t.kind == "name":
+            name = t.text
+            if name.lower() in self._MATH_FUNCS and self.peek(1).text == "(":
+                self.next()
+                self.expect("punct", "(")
+                node = MathTree(fn=name.lower())
+                node.children.append(self._math_expr(0))
+                while self.accept("punct", ","):
+                    node.children.append(self._math_expr(0))
+                self.expect("punct", ")")
+                return node
+            self.next()
+            return MathTree(var=name)
+        raise ParseError(f"bad math expression at {t.text!r}")
+
+    # -- schema request ----------------------------------------------------
+
+    def _parse_schema_request(self) -> SchemaRequest:
+        req = SchemaRequest()
+        if self.accept("punct", "("):
+            self.expect("name", "pred")
+            self.expect("punct", ":")
+            if self.accept("punct", "["):
+                while not self.accept("punct", "]"):
+                    self.accept("punct", ",")
+                    if self.peek().text == "]":
+                        continue
+                    req.predicates.append(self._value_token())
+            else:
+                req.predicates.append(self._value_token())
+            self.expect("punct", ")")
+        self.expect("punct", "{")
+        while not self.accept("punct", "}"):
+            self.accept("punct", ",")
+            if self.peek().text == "}":
+                continue
+            req.fields.append(self.expect("name").text)
+        return req
+
+    # -- fragments ---------------------------------------------------------
+
+    def _expand_fragments_all(self, res: ParsedResult):
+        for q in res.queries:
+            self._expand_fragments(q, set())
+
+    def _expand_fragments(self, gq: GraphQuery, seen: frozenset):
+        out = []
+        for c in gq.children:
+            if c.attr == "...fragment":
+                name = c.alias
+                if name in seen:
+                    raise ParseError(f"fragment cycle at {name}")
+                body = self.fragments.get(name)
+                if body is None:
+                    raise ParseError(f"missing fragment {name}")
+                import copy
+
+                for item in body:
+                    item2 = copy.deepcopy(item)
+                    holder = GraphQuery(children=[item2])
+                    self._expand_fragments(holder, set(seen) | {name})
+                    out.extend(holder.children)
+            else:
+                self._expand_fragments(c, seen)
+                out.append(c)
+        gq.children = out
+
+    # -- var dependency collection ------------------------------------------
+
+    def _collect_query_vars(self, res: ParsedResult):
+        for q in res.queries:
+            defines: List[str] = []
+            needs: List[str] = []
+            self._walk_vars(q, defines, needs, is_root=True)
+            res.query_vars.append((defines, needs))
+        # error on undefined vars across the request (checkDependency:605)
+        all_defs = {d for ds, _ in res.query_vars for d in ds}
+        for q, (_ds, ns) in zip(res.queries, res.query_vars):
+            for n in ns:
+                if n not in all_defs:
+                    raise ParseError(f"variable {n!r} used but not defined")
+
+    def _walk_vars(self, gq: GraphQuery, defines, needs, is_root=False):
+        if gq.var:
+            defines.append(gq.var)
+        if gq.facets:
+            defines.extend(gq.facets.aliases.values())  # "a as facetkey"
+        for vr in gq.needs_var:
+            needs.append(vr.name)
+        if gq.func:
+            for vr in gq.func.needs_vars:
+                needs.append(vr.name)
+        if gq.filter:
+            self._walk_filter_vars(gq.filter, needs)
+        if gq.math_exp:
+            self._walk_math_vars(gq.math_exp, needs)
+        for c in gq.children:
+            self._walk_vars(c, defines, needs)
+
+    def _walk_filter_vars(self, ft: FilterTree, needs):
+        if ft.func:
+            for vr in ft.func.needs_vars:
+                needs.append(vr.name)
+        for c in ft.children:
+            self._walk_filter_vars(c, needs)
+
+    def _walk_math_vars(self, mt: MathTree, needs):
+        if mt.var:
+            needs.append(mt.var)
+        for c in mt.children:
+            self._walk_math_vars(c, needs)
+
+
+def _is_uid(s: str) -> bool:
+    return bool(re.fullmatch(r"0[xX][0-9a-fA-F]+|\d+", s))
+
+
+def _parse_uid(s: str) -> int:
+    if s.lower().startswith("0x"):
+        return int(s, 16)
+    if s.isdigit():
+        return int(s)
+    raise ParseError(f"invalid uid {s!r}")
+
+
+def _match_brace(text: str, open_idx: int) -> int:
+    """Index of the '}' matching text[open_idx] == '{' (string/comment aware)."""
+    depth = 0
+    i = open_idx
+    n = len(text)
+    while i < n:
+        c = text[i]
+        if c == '"':
+            i += 1
+            while i < n and text[i] != '"':
+                i += 2 if text[i] == "\\" else 1
+        elif c == "#":
+            while i < n and text[i] != "\n":
+                i += 1
+        elif c == "<":  # IRI — may contain braces? keep simple: skip to '>'
+            j = text.find(">", i + 1)
+            if j != -1 and "\n" not in text[i:j]:
+                i = j
+        elif c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return i
+        i += 1
+    raise ParseError("unbalanced braces")
+
+
+_SECTION_RE = re.compile(r"\b(set|delete|del|schema)\s*\{")
+
+
+def _find_toplevel_mutation(text: str) -> Optional[re.Match]:
+    """Find 'mutation {' at brace depth 0, outside strings/comments —
+    a regex search alone would match inside string literals or a
+    predicate subtree named 'mutation'."""
+    depth = 0
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == '"':
+            i += 1
+            while i < n and text[i] != '"':
+                i += 2 if text[i] == "\\" else 1
+        elif c == "#":
+            while i < n and text[i] != "\n":
+                i += 1
+        elif c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+        elif depth == 0 and text.startswith("mutation", i) and (
+            i == 0 or not (text[i - 1].isalnum() or text[i - 1] in "_.")
+        ):
+            j = i + len("mutation")
+            while j < n and text[j].isspace():
+                j += 1
+            if j < n and text[j] == "{":
+                return _FakeMatch(i, j)
+        i += 1
+    return None
+
+
+class _FakeMatch:
+    """Minimal match-like holder: start of keyword + index of '{'."""
+
+    def __init__(self, start: int, brace: int):
+        self._start, self.brace = start, brace
+
+    def start(self) -> int:
+        return self._start
+
+
+def _extract_mutation(text: str) -> Tuple[str, Optional[Mutation]]:
+    """Cut the top-level ``mutation { set {...} delete {...} schema {...} }``
+    out of the request text before lexing — N-Quad bodies are not lexable
+    as query tokens (they contain bare '.', '^^', etc.)."""
+    m = _find_toplevel_mutation(text)
+    if m is None:
+        return text, None
+    open_idx = m.brace
+    close_idx = _match_brace(text, open_idx)
+    body = text[open_idx + 1 : close_idx]
+    mu = Mutation()
+    pos = 0
+    while True:
+        sm = _SECTION_RE.search(body, pos)
+        if sm is None:
+            break
+        o = body.index("{", sm.start())
+        c = _match_brace(body, o)
+        content = body[o + 1 : c]
+        kw = sm.group(1)
+        if kw == "set":
+            mu.set_nquads = content
+        elif kw in ("delete", "del"):
+            mu.del_nquads = content
+        else:
+            mu.schema = content
+        pos = c + 1
+    rest = text[: m.start()] + text[close_idx + 1 :]
+    return rest, mu
+
+
+def parse(text: str, variables: Optional[Dict[str, str]] = None) -> ParsedResult:
+    """Parse a GraphQL± request.
+
+    Accepts either a bare query string or the HTTP JSON wrapper
+    {"query": "...", "variables": {...}} (gql.Parse with Request.Http).
+    """
+    stripped = text.lstrip()
+    gqlvars: Dict[str, str] = dict(variables or {})
+    if stripped.startswith("{") and '"query"' in stripped[:400]:
+        try:
+            obj = json.loads(stripped)
+        except json.JSONDecodeError:
+            obj = None
+        if isinstance(obj, dict) and "query" in obj:
+            text = obj["query"]
+            v = obj.get("variables") or {}
+            if isinstance(v, str):
+                v = json.loads(v) if v else {}
+            # keep JSON lexical form: true/false/null, not True/False/None
+            gqlvars.update(
+                {
+                    k: (val if isinstance(val, str) else json.dumps(val))
+                    for k, val in v.items()
+                }
+            )
+    text, mutation = _extract_mutation(text)
+    toks = _lex(text)
+    p = _Parser(toks, gqlvars)
+    res = p.parse()
+    if mutation is not None:
+        res.mutation = mutation
+    return res

@@ -1,0 +1,441 @@
+"""GraphQL± parser tests, modeled on the reference's gql/parser_test.go
+cases (same query shapes, same acceptance/rejection behavior)."""
+
+import pytest
+
+from dgraph_tpu import gql
+from dgraph_tpu.gql import parse, ParseError
+
+
+def child_attrs(q):
+    return [c.attr for c in q.children]
+
+
+def test_basic_query():
+    res = parse("""
+    {
+      me(func: uid(0x0a)) {
+        friends { name }
+        gender,age
+        hometown
+      }
+    }""")
+    assert len(res.queries) == 1
+    q = res.queries[0]
+    assert q.alias == "me"
+    assert q.func.name == "uid" and q.func.uid_args == [0x0A]
+    assert child_attrs(q) == ["friends", "gender", "age", "hometown"]
+    assert child_attrs(q.children[0]) == ["name"]
+
+
+def test_root_func_and_args():
+    res = parse("""
+    query {
+      me(func: eq(name@en, "Steven Spielberg"), first: -4, offset: +1) {
+        name
+      }
+    }""")
+    q = res.queries[0]
+    assert q.func.name == "eq" and q.func.attr == "name" and q.func.lang == "en"
+    assert q.func.args == ["Steven Spielberg"]
+    assert q.args["first"] == "-4" and q.args["offset"] == "+1"
+
+
+def test_id_sugar_and_uid_list():
+    res = parse("{ me(id: [1, 3, 0x5]) { name } }")
+    assert res.queries[0].uid_list == [1, 3, 5]
+    res = parse("{ me(func: uid(1, 2, 3)) { name } }")
+    assert res.queries[0].func.uid_args == [1, 2, 3]
+
+
+def test_alias_and_langs():
+    res = parse("""
+    {
+      me(func: uid(0x0a)) {
+        name: type.object.name.en
+        bestFriend: friends(first: 10) {
+          name@en@de
+        }
+      }
+    }""")
+    q = res.queries[0]
+    assert q.children[0].alias == "name"
+    assert q.children[0].attr == "type.object.name.en"
+    bf = q.children[1]
+    assert bf.alias == "bestFriend" and bf.attr == "friends"
+    assert bf.args["first"] == "10"
+    assert bf.children[0].attr == "name" and bf.children[0].langs == ["en", "de"]
+
+
+def test_filters_precedence():
+    res = parse("""
+    {
+      me(func: uid(0x0a)) {
+        friends @filter(a(aa, "aaa") or b(bb, "bbb") and c(cc, "ccc")) { name }
+      }
+    }""")
+    f = res.queries[0].children[0].filter
+    assert f.op == "or"
+    assert f.children[0].func.name == "a"
+    assert f.children[1].op == "and"
+
+
+def test_filter_not_and_parens():
+    res = parse("""
+    {
+      me(func: uid(0x0a)) {
+        friends @filter(not (a(aa, "aaa") or b(bb, "bbb")) and c(cc, "ccc")) { name }
+      }
+    }""")
+    f = res.queries[0].children[0].filter
+    assert f.op == "and"
+    assert f.children[0].op == "not"
+    assert f.children[0].children[0].op == "or"
+
+
+def test_filter_count_and_val():
+    res = parse("""
+    {
+      me(func: uid(1)) @filter(gt(count(friends), 10)) { name }
+    }""")
+    f = res.queries[0].filter
+    assert f.func.is_count and f.func.attr == "friends" and f.func.args == ["10"]
+    res = parse("""
+    {
+      var(func: uid(1)) { fr as friends { a as age } }
+      me(func: uid(fr)) @filter(gt(val(a), 10)) { name }
+    }""")
+    f = res.queries[1].filter
+    assert f.func.is_val_var and f.func.needs_vars[0].name == "a"
+
+
+def test_empty_filter_error():
+    with pytest.raises(ParseError):
+        parse('{ me(func: uid(1)) { friends @filter(  () { name } } }')
+
+
+def test_variables_definition_and_use():
+    res = parse("""
+    query test($a: int, $b: string = "hello") {
+      me(func: eq(name, $b), first: $a) { name }
+    }""", variables={"$a": "7"})
+    q = res.queries[0]
+    assert q.func.args == ["hello"]
+    assert q.args["first"] == "7"
+
+
+def test_json_wrapper():
+    res = parse('{"query": "query q($v: int){me(func: eq(type, $v)){name}}", '
+                '"variables": {"$v": "3"}}')
+    assert res.queries[0].func.args == ["3"]
+
+
+def test_var_def_and_use():
+    res = parse("""
+    {
+      var(func: uid(0x0a)) { L as friends { B as relatives } }
+      me(func: uid(L)) { name }
+      you(func: uid(B)) { name }
+    }""")
+    assert res.queries[0].is_internal
+    assert res.query_vars[0] == (["L", "B"], [])
+    assert res.query_vars[1][1] == ["L"]
+    assert res.query_vars[2][1] == ["B"]
+
+
+def test_undefined_var_error():
+    with pytest.raises(ParseError):
+        parse("{ me(func: uid(L)) { name } }")
+
+
+def test_value_vars_and_aggregation():
+    res = parse("""
+    {
+      me(func: uid(L), orderasc: val(n)) { name }
+      var(func: uid(0x0a)) {
+        L AS friends { na as name }
+        n as min(val(na))
+      }
+    }""")
+    q0, q1 = res.queries
+    assert q0.args["orderasc"] == "val:n"
+    assert q1.children[0].var == "L"
+    assert q1.children[1].agg_func == "min"
+    assert q1.children[1].var == "n"
+
+
+def test_count_child_and_count_var():
+    res = parse("""
+    {
+      me(func: uid(1)) {
+        count(friends)
+        n as count(relatives)
+      }
+    }""")
+    c0, c1 = res.queries[0].children
+    assert c0.is_count and c0.attr == "friends"
+    assert c1.is_count and c1.var == "n"
+
+
+def test_math_tree():
+    res = parse("""
+    {
+      var(func: uid(0x0a)) {
+        L as friends {
+          a as age
+          b as count(friends)
+          c as count(relatives)
+          d as math(a + b * c / a + exp(a + b + 1) - ln(c))
+        }
+      }
+    }""")
+    d = res.queries[0].children[0].children[3]
+    assert d.var == "d"
+    assert d.math_exp.debug() == \
+        "(+ (+ a (* b (/ c a))) (- (exp (+ (+ a b) 1.0)) (ln c)))"
+
+
+def test_math_cond():
+    res = parse("""
+    {
+      var(func: uid(1)) {
+        f as friends {
+          a as age
+          d as math(cond(a <= 10, exp(a + 1), ln(a)) + 10*a)
+        }
+      }
+    }""")
+    d = res.queries[0].children[0].children[1]
+    assert d.math_exp.fn == "+"
+    assert d.math_exp.children[0].fn == "cond"
+
+
+def test_expand_all_and_val():
+    res = parse("""
+    {
+      var(func: uid(0x0a)) { friends { expand(_all_) } }
+    }""")
+    assert res.queries[0].children[0].children[0].expand == "_all_"
+    res = parse("""
+    {
+      var(func: uid(0x0a)) { l as _predicate_ }
+      me(func: uid(0x0a)) { expand(val(l)) }
+    }""")
+    assert res.queries[1].children[0].expand == "l"
+
+
+def test_shortest_block():
+    res = parse("""
+    {
+      shortest(from: 0x0a, to: 0x0b, numpaths: 3) {
+        friends
+        name
+      }
+    }""")
+    q = res.queries[0]
+    assert q.alias == "shortest"
+    assert q.args["from"] == "0x0a" and q.args["to"] == "0x0b"
+    assert q.args["numpaths"] == "3"
+
+
+def test_recurse_block():
+    res = parse("""
+    {
+      recurse(func: uid(0x0a), depth: 5) { friends name }
+    }""")
+    q = res.queries[0]
+    assert q.alias == "recurse" and q.args["depth"] == "5"
+
+
+def test_groupby():
+    res = parse("""
+    {
+      me(func: uid(1, 2, 3)) @groupby(friends) { count(_uid_) }
+    }""")
+    q = res.queries[0]
+    assert q.is_groupby and q.groupby_attrs == [("friends", "")]
+
+
+def test_facets():
+    res = parse("""
+    query {
+      me(func: uid(0x1)) {
+        friends @facets(orderdesc: closeness) { name }
+        hometown @facets
+        school @facets(since, a as established)
+      }
+    }""")
+    c = res.queries[0].children
+    assert c[0].facets.order_key == "closeness" and c[0].facets.order_desc
+    assert c[1].facets.all_keys
+    assert c[2].facets.keys == ["since", "established"]
+    assert c[2].facets.aliases == {"established": "a"}
+
+
+def test_facets_errors():
+    with pytest.raises(ParseError):
+        parse("{ me(func: uid(1)) { friends @facets(a as b as c) { name } } }")
+    with pytest.raises(ParseError):
+        parse("{ me(func: uid(1)) { friends @facets(f1,, f2) { name } } }")
+
+
+def test_facet_filter():
+    res = parse("""
+    {
+      me(func: uid(1)) {
+        friends @facets(eq(close, true)) { name }
+      }
+    }""")
+    ff = res.queries[0].children[0].facets_filter
+    assert ff.func.name == "eq" and ff.func.attr == "close"
+
+
+def test_geo_funcs():
+    res = parse("""
+    {
+      me(func: near(loc, [-122.469829, 37.771935], 1000)) { name }
+    }""")
+    f = res.queries[0].func
+    assert f.name == "near" and f.attr == "loc"
+    assert f.args[0] == "[-122.469829, 37.771935]"
+    assert f.args[1] == "1000"
+    res = parse("""
+    {
+      me(func: uid(1)) {
+        friends @filter(within(loc, [[11.2, -2.234], [-31.23, 4.3214], [5.312, 6.53]])) { name }
+      }
+    }""")
+    f = res.queries[0].children[0].filter.func
+    assert f.name == "within"
+
+
+def test_directives():
+    res = parse("{ me(func: uid(0x3)) @normalize { name } }")
+    assert res.queries[0].normalize
+    res = parse("{ me(func: uid(0x3)) @cascade @ignorereflex { name } }")
+    assert res.queries[0].cascade and res.queries[0].ignore_reflex
+
+
+def test_fragments():
+    res = parse("""
+    query {
+      user(func: uid(0x0a)) {
+        ...fragmenta
+        ...fragmentb
+        friends { name }
+      }
+    }
+    fragment fragmenta { name }
+    fragment fragmentb { id ...fragmentc }
+    fragment fragmentc { hobbies }
+    """)
+    q = res.queries[0]
+    assert child_attrs(q) == ["name", "id", "hobbies", "friends"]
+
+
+def test_fragment_missing_and_cycle():
+    with pytest.raises(ParseError):
+        parse("""
+        query { user(func: uid(1)) { ...missing } }
+        """)
+    with pytest.raises(ParseError):
+        parse("""
+        query { user(func: uid(1)) { ...a } }
+        fragment a { ...b }
+        fragment b { ...a }
+        """)
+
+
+def test_mutation_blocks():
+    res = parse("""
+    mutation {
+      set {
+        <alice> <follows> <bob> .
+        <alice> <name> "Alice"@en .
+        <alice> <age> "13"^^<xs:int> .
+      }
+      delete {
+        <alice> <follows> <carol> .
+      }
+      schema {
+        name: string @index(term) .
+      }
+    }""")
+    mu = res.mutation
+    assert '<alice> <follows> <bob> .' in mu.set_nquads
+    assert '"Alice"@en' in mu.set_nquads
+    assert "<carol>" in mu.del_nquads
+    assert "@index(term)" in mu.schema
+
+
+def test_mutation_and_query_together():
+    res = parse("""
+    mutation { set { <a> <p> <b> . } }
+    query { me(func: uid(1)) { name } }
+    """)
+    assert res.mutation is not None
+    assert len(res.queries) == 1
+
+
+def test_schema_request():
+    res = parse("schema (pred: [name, hi]) { pred type }")
+    assert res.schema_request.predicates == ["name", "hi"]
+    assert res.schema_request.fields == ["pred", "type"]
+    res = parse("schema { pred type }")
+    assert res.schema_request.predicates == []
+
+
+def test_checkpwd():
+    res = parse('{ me(func: uid(1)) { checkpwd(password, "123456") } }')
+    c = res.queries[0].children[0]
+    assert c.func.name == "checkpwd" and c.func.args == ["123456"]
+
+
+def test_aliased_special_children():
+    res = parse("""
+    {
+      me(func: uid(1)) {
+        total: count(friends)
+        score: math(2 + 1)
+        v: val(x)
+        x as age
+      }
+    }""")
+    c = res.queries[0].children
+    assert c[0].alias == "total" and c[0].is_count
+    assert c[1].alias == "score" and c[1].math_exp is not None
+    assert c[2].alias == "v" and c[2].needs_var[0].name == "x"
+
+
+def test_comments_and_commas():
+    res = parse("""
+    # leading comment
+    {
+      me(func: uid(0x0a)) {  # block comment
+        name, age  # trailing
+      }
+    }""")
+    assert child_attrs(res.queries[0]) == ["name", "age"]
+
+
+def test_iri_attrs():
+    res = parse("""
+    {
+      me(func: uid(1)) {
+        friends @filter(allofterms(<http://verygood.com/what/about/you>, "hello")) { name }
+      }
+    }""")
+    f = res.queries[0].children[0].filter.func
+    assert f.attr == "http://verygood.com/what/about/you"
+
+
+def test_regexp_and_terms():
+    res = parse("""
+    {
+      me(func: regexp(name, "^[Ss]teven")) {
+        friends @filter(anyofterms(name, "alice bob")) { name }
+      }
+    }""")
+    assert res.queries[0].func.name == "regexp"
+    f = res.queries[0].children[0].filter.func
+    assert f.name == "anyofterms" and f.args == ["alice bob"]
