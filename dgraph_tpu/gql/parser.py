@@ -45,7 +45,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>"(?:\\.|[^"\\])*")
   | (?P<iri><[^>\s]+>)
   | (?P<number>0[xX][0-9a-fA-F]+|\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)
-  | (?P<name>[A-Za-z_][A-Za-z0-9_.]*)
+  | (?P<name>~?[A-Za-z_][A-Za-z0-9_.]*)
   | (?P<dollar>\$[A-Za-z_][A-Za-z0-9_]*)
   | (?P<spread>\.\.\.)
   | (?P<op><=|>=|==|!=|&&|\|\||=|[-+*/%<>])
@@ -516,7 +516,8 @@ class _Parser:
             else:
                 raise ParseError(f"bad @facets content at {t.text!r}")
         self.expect("punct", ")")
-        gq.facets = spec
+        if spec.keys or spec.all_keys or spec.order_key or spec.aliases:
+            gq.facets = spec  # filter-only @facets(...) fetches nothing
 
     # -- children ----------------------------------------------------------
 
@@ -569,6 +570,11 @@ class _Parser:
         low = name.lower()
         if low == "count" and self.peek().text == "(":
             self.expect("punct", "(")
+            if self.accept("punct", ")"):  # bare count(): count of uids
+                gq.attr = ""
+                gq.is_count = True
+                self._parse_directives(gq)
+                return gq
             inner = self.expect("name").text
             if inner == "var" or inner == "val":
                 raise ParseError("count(val()) is not allowed")
@@ -587,7 +593,6 @@ class _Parser:
             gq.attr = "val"
             gq.agg_func = low
             gq.needs_var.append(VarRef(v, VALUE_VAR))
-            gq.is_internal = not bool(gq.var)
         elif low == "val" and self.peek().text == "(":
             self.expect("punct", "(")
             v = self.expect("name").text
@@ -597,7 +602,7 @@ class _Parser:
         elif low == "math" and self.peek().text == "(":
             gq.attr = "math"
             gq.math_exp = self._parse_math()
-            gq.is_internal = not bool(gq.var)
+            gq.is_internal = not bool(gq.var) and not bool(gq.alias)
         elif low == "expand" and self.peek().text == "(":
             self.expect("punct", "(")
             inner = self.expect("name").text
@@ -839,6 +844,9 @@ def _match_brace(text: str, open_idx: int) -> int:
 
 
 _SECTION_RE = re.compile(r"\b(set|delete|del|schema)\s*\{")
+_REGEXP_ARG_RE = re.compile(
+    r"(regexp\s*\(\s*[^,()]+?,\s*)/((?:\\.|[^/\\\n])*)/([a-z]*)"
+)
 
 
 def _find_toplevel_mutation(text: str) -> Optional[re.Match]:
@@ -939,6 +947,12 @@ def parse(text: str, variables: Optional[Dict[str, str]] = None) -> ParsedResult
                 }
             )
     text, mutation = _extract_mutation(text)
+    # /re/ literals are only legal as regexp() args; quote them before
+    # lexing so '/' never collides with the division operator
+    text = _REGEXP_ARG_RE.sub(
+        lambda m: m.group(1) + json.dumps("/" + m.group(2) + "/" + m.group(3)),
+        text,
+    )
     toks = _lex(text)
     p = _Parser(toks, gqlvars)
     res = p.parse()

@@ -79,6 +79,42 @@ def _build_csr(rows_to_dsts: Dict[int, np.ndarray]) -> CSRArena:
     return _csr_from_arrays(keys, offsets, dst)
 
 
+def _sorted_unique_edges(src: np.ndarray, dst: np.ndarray):
+    """Sort edge pairs by (src, dst) and drop duplicates (vectorized)."""
+    src = np.asarray(src, dtype=np.int64)
+    dst = np.asarray(dst, dtype=np.int64)
+    order = np.lexsort((dst, src))
+    s, d = src[order], dst[order]
+    if len(s):
+        keep = np.ones(len(s), dtype=bool)
+        keep[1:] = (s[1:] != s[:-1]) | (d[1:] != d[:-1])
+        s, d = s[keep], d[keep]
+    return s, d
+
+
+def csr_from_edges(src: np.ndarray, dst: np.ndarray) -> CSRArena:
+    """Vectorized bulk CSR construction from parallel edge arrays — the
+    bulk-load path (no per-row python loops; the dict-of-sets store path
+    is for incremental mutations only)."""
+    s, d = _sorted_unique_edges(src, dst)
+    keys, counts = np.unique(s, return_counts=True)
+    offsets = np.zeros(len(keys) + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    return _csr_from_arrays(keys, offsets, d.astype(np.int32))
+
+
+def csr_dense_from_edges(src: np.ndarray, dst: np.ndarray, n_nodes: int) -> CSRArena:
+    """Dense CSR: one row per uid in [0, n_nodes] (degree 0 where absent),
+    so frontier uids ARE row indices — no searchsorted on the query path.
+    The layout of choice for whole-graph predicates at bench scale."""
+    s, d = _sorted_unique_edges(src, dst)
+    counts = np.bincount(s, minlength=n_nodes + 1)
+    offsets = np.zeros(n_nodes + 2, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    keys = np.arange(n_nodes + 1, dtype=np.int64)
+    return _csr_from_arrays(keys, offsets, d.astype(np.int32))
+
+
 def _csr_from_arrays(keys: np.ndarray, offsets: np.ndarray, dst: np.ndarray) -> CSRArena:
     S, E = len(keys), len(dst)
     Sb = ops.bucket(max(1, S))

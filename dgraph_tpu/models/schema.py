@@ -117,21 +117,26 @@ _LINE_RE = re.compile(
 _DIRECTIVE_RE = re.compile(r"@(\w+)(?:\(([^)]*)\))?")
 
 
+def split_entries(text: str) -> List[str]:
+    """Split schema text into '.'-terminated entries (several may share a
+    line); a standalone '.' token ends an entry — dots inside predicate
+    names don't split."""
+    stripped = "\n".join(l.split("#", 1)[0] for l in text.splitlines())
+    return [e.strip() for e in re.split(r"(?<=[\s)])\.(?=\s|$)", stripped) if e.strip()]
+
+
 def parse_schema(text: str, into: Optional[SchemaState] = None) -> SchemaState:
     """Parse schema-language text (schema/parse.go:265).
 
-    Syntax per line: ``pred: type [@index(tok1, tok2)] [@reverse] [@count] .``
+    Syntax per entry: ``pred: type [@index(tok1, tok2)] [@reverse] [@count] .``
     ``@index`` with no argument selects the default tokenizer for the type
     (schema/parse.go resolveTokenizers:216).
     """
     state = into if into is not None else SchemaState()
-    for lineno, raw in enumerate(text.splitlines(), 1):
-        line = raw.split("#", 1)[0].strip()
-        if not line:
-            continue
+    for lineno, line in enumerate(split_entries(text), 1):
         m = _LINE_RE.match(line)
         if not m:
-            raise ValueError(f"schema line {lineno}: cannot parse {raw!r}")
+            raise ValueError(f"schema entry {lineno}: cannot parse {raw!r}")
         name = m.group("name")
         tname = m.group("type").strip().strip("[]").strip()
         tid = type_from_name(tname)

@@ -25,5 +25,7 @@ from dgraph_tpu.ops.sets import (  # noqa: F401
     count_valid,
     rows_of,
     range_rows,
+    unique_dense,
+    frontier_rows,
 )
 from dgraph_tpu.ops import ref  # noqa: F401

@@ -31,8 +31,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import os
+
 # Padding sentinel: int32 max. Sorts after every valid uid.
 SENT = (1 << 31) - 1
+
+# expand_csr owner-computation strategy; see comment in expand_csr.
+_EXPAND_IMPL = os.environ.get("DGRAPH_TPU_EXPAND_IMPL", "scan")
 
 
 def bucket(n: int, floor: int = 8) -> int:
@@ -182,15 +187,57 @@ def expand_csr(
     cum = jnp.cumsum(deg)
     total = cum[-1] if nrows > 0 else jnp.int32(0)
     start = cum - deg
+    # Owner of output slot i = the row whose [start, start+deg) covers i.
+    # Two interchangeable constructions (DGRAPH_TPU_EXPAND_IMPL):
+    #  "scan"  (default): scatter an indicator at each productive row's
+    #          start slot, prefix-sum to get the owning productive-row
+    #          ordinal, map through the compacted row list — O(cap)
+    #          memory-bound work.
+    #  "search": vectorized binary search over the cumulative degrees —
+    #          cap×log(nrows) random gathers; slower at large caps but a
+    #          safe fallback while the scan path is qualified per stack.
+    if _EXPAND_IMPL == "search":
+        i = jnp.arange(cap, dtype=jnp.int32)
+        seg = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
+        segc = jnp.clip(seg, 0, nrows - 1)
+    else:
+        productive = deg > 0
+        slot = jnp.where(productive, start, cap)  # cap = dropped
+        ind = jnp.zeros((cap,), dtype=jnp.int32).at[slot].set(1, mode="drop")
+        k = jnp.cumsum(ind) - 1  # ordinal of the owning productive row
+        prows = jnp.nonzero(productive, size=nrows, fill_value=0)[0].astype(jnp.int32)
+        seg = prows[jnp.clip(k, 0, nrows - 1)]
+        segc = jnp.clip(seg, 0, nrows - 1)
     i = jnp.arange(cap, dtype=jnp.int32)
-    # Owner of output slot i = first row whose cumulative degree exceeds i.
-    seg = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
-    segc = jnp.clip(seg, 0, nrows - 1)
     within = i - start[segc]
     edge = offsets[r[segc]] + within
     ok = i < total
     out = jnp.where(ok, dst[jnp.clip(edge, 0, dst.shape[0] - 1)], SENT)
     return out, jnp.where(ok, segc, -1), total.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("n_universe", "cap"))
+def unique_dense(x: jnp.ndarray, n_universe: int, cap: int) -> jnp.ndarray:
+    """Sort-free dedup for dense uid spaces: scatter into a presence mask
+    over [0, n_universe], then fixed-size nonzero (cumsum-based
+    compaction).  O(n_universe + |x|) memory-bound work instead of the
+    O(n log^2 n) bitonic sorts of sort_unique — the reason the engine
+    uses dense int32 uids.  Result is ascending, SENT-padded; silently
+    truncates if more than ``cap`` distinct values (callers size cap to
+    the universe or the input length)."""
+    mask = jnp.zeros(n_universe + 2, dtype=bool)
+    slot = jnp.where((x >= 0) & (x <= n_universe), x, n_universe + 1)
+    mask = mask.at[slot].set(True)
+    mask = mask.at[n_universe + 1].set(False)
+    idx = jnp.nonzero(mask, size=cap, fill_value=SENT)[0]
+    return idx.astype(jnp.int32)
+
+
+@jax.jit
+def frontier_rows(f: jnp.ndarray) -> jnp.ndarray:
+    """Frontier uids → row indices for a *dense* arena (row i == uid i):
+    just map padding to the skip marker."""
+    return jnp.where(f == SENT, -1, f).astype(jnp.int32)
 
 
 @jax.jit

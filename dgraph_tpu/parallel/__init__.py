@@ -1,0 +1,16 @@
+"""Mesh sharding and distributed execution.
+
+Equivalent of the reference's group/ (predicate→group routing,
+group/conf.go:182-200) + the worker fan-out RPCs — re-designed for a TPU
+mesh: arenas shard by uid range across devices (the intra-predicate
+sharding the reference lacks, SURVEY.md §5), frontier expansion runs
+under shard_map with XLA collectives over ICI instead of gRPC calls.
+"""
+
+from dgraph_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    shard_arena_rows,
+    sharded_expand_step,
+    sharded_two_hop,
+    predicate_shard,
+)

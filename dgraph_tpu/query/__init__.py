@@ -1,0 +1,12 @@
+"""The query engine.
+
+Equivalent of the reference's query/ package + worker/task.go hot path:
+AST → SubGraph tree (query/query.go ToSubGraph:850), level-batched
+execution over device arenas (ProcessGraph:1579 re-designed: one batched
+CSR gather per (level × predicate) instead of per-key posting-list loops),
+filter algebra on device, pagination/ordering, variables, aggregation,
+math, groupby, and JSON encoding (query/outputnode.go).
+"""
+
+from dgraph_tpu.query.engine import QueryEngine  # noqa: F401
+from dgraph_tpu.query.subgraph import SubGraph, Params  # noqa: F401

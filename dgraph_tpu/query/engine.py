@@ -1,0 +1,631 @@
+"""Query execution.
+
+Equivalent of the reference's query.ProcessQuery / ProcessGraph
+(query/query.go:2182,1579) and worker/task.go's task serving, re-designed
+level-batched: each (level × predicate) becomes ONE device CSR gather
+over the arena (ops.expand_csr) instead of per-key posting-list loops,
+filters combine uid sets with the device set kernels, and ordering uses
+value arenas.  Host code orchestrates and handles string-shaped work
+(JSON values, lossy re-checks) — the same host/device split the
+reference draws at the ServeTask boundary (SURVEY.md §2c).
+"""
+
+from __future__ import annotations
+
+import math as pymath
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dgraph_tpu import gql, ops
+from dgraph_tpu.gql.ast import FilterTree, Function, GraphQuery, MathTree
+from dgraph_tpu.models.arena import ArenaManager
+from dgraph_tpu.models.store import PostingStore
+from dgraph_tpu.models.types import TypeID, TypedValue, numeric, sort_key
+from dgraph_tpu.query.functions import FuncResolver, QueryError
+from dgraph_tpu.query.subgraph import SubGraph, build_subgraph
+from dgraph_tpu.query import outputnode
+
+_EMPTY = np.empty(0, dtype=np.int64)
+
+
+class QueryEngine:
+    """One engine instance per store; thread-unsafe by design (the serving
+    layer serializes, as the reference does per-request goroutines over
+    shared immutable posting state)."""
+
+    def __init__(self, store: PostingStore):
+        self.store = store
+        self.arenas = ArenaManager(store)
+
+    # -- public ------------------------------------------------------------
+
+    def run(self, text: str, variables: Optional[Dict[str, str]] = None) -> dict:
+        """Parse and execute a request; returns the JSON-able response dict
+        (the analog of ProcessWithMutation + ToFastJSON)."""
+        parsed = gql.parse(text, variables)
+        if parsed.mutation is not None:
+            from dgraph_tpu.serve.mutations import apply_mutation
+
+            apply_mutation(self.store, parsed.mutation)
+        out: dict = {}
+        if parsed.schema_request is not None:
+            out["schema"] = self._schema_response(parsed.schema_request)
+        if parsed.queries:
+            out.update(self.execute(parsed))
+        elif parsed.mutation is not None and "schema" not in out:
+            out["code"] = "Success"
+            out["message"] = "Done"
+        return out
+
+    def execute(self, parsed: gql.ParsedResult) -> dict:
+        uid_vars: Dict[str, np.ndarray] = {}
+        value_vars: Dict[str, Dict[int, TypedValue]] = {}
+        blocks = [build_subgraph(q) for q in parsed.queries]
+        deps = parsed.query_vars
+
+        done = [False] * len(blocks)
+        out: dict = {}
+        for _round in range(len(blocks) + 1):
+            progressed = False
+            for i, sg in enumerate(blocks):
+                if done[i]:
+                    continue
+                defines = deps[i][0] if i < len(deps) else []
+                needs = deps[i][1] if i < len(deps) else []
+                # a block may consume vars it defines itself (math over
+                # sibling-defined vars); only external needs gate scheduling
+                if any(
+                    n not in uid_vars and n not in value_vars and n not in defines
+                    for n in needs
+                ):
+                    continue
+                self._exec_block(sg, uid_vars, value_vars)
+                done[i] = True
+                progressed = True
+            if all(done):
+                break
+            if not progressed:
+                raise QueryError("circular variable dependency between blocks")
+
+        for sg in blocks:
+            if sg.params.is_internal:
+                continue
+            name = sg.params.alias or "me"
+            if sg.params.is_shortest:
+                outputnode.encode_path(self.store, sg, out)
+                continue
+            out.setdefault(name, []).extend(
+                outputnode.encode_block(self.store, sg)
+            )
+        return out
+
+    # -- block execution ---------------------------------------------------
+
+    def _exec_block(self, sg: SubGraph, uid_vars, value_vars):
+        resolver = FuncResolver(self.store, self.arenas, uid_vars, value_vars)
+        if sg.params.is_shortest:
+            from dgraph_tpu.query.shortest import shortest_path
+
+            shortest_path(self, sg, resolver)
+            self._collect_vars(sg, uid_vars, value_vars)
+            return
+        dest = self._root_uids(sg, resolver)
+        if sg.filter is not None:
+            dest = self._apply_filter(sg.filter, dest, resolver)
+        dest = self._order_and_paginate_root(sg, dest, value_vars)
+        sg.dest_uids = dest
+        if sg.params.is_recurse:
+            from dgraph_tpu.query.recurse import recurse
+
+            recurse(self, sg, resolver)
+        else:
+            self._exec_children(sg, resolver, uid_vars, value_vars)
+        self._collect_vars(sg, uid_vars, value_vars)
+
+    def _root_uids(self, sg: SubGraph, resolver: FuncResolver) -> np.ndarray:
+        if sg.func is None:
+            raise QueryError(f"block {sg.params.alias!r} needs func: or id:")
+        return resolver.resolve(sg.func)
+
+    # -- children ----------------------------------------------------------
+
+    def _exec_children(self, sg: SubGraph, resolver, uid_vars, value_vars):
+        src = sg.dest_uids
+        self._expand_expand_nodes(sg, value_vars)
+        for child in sg.children:
+            self._exec_child(child, src, resolver, uid_vars, value_vars)
+
+    def _expand_expand_nodes(self, sg: SubGraph, value_vars):
+        """expand(_all_) / expand(val(v)) → concrete children
+        (query/query.go:1780-1813)."""
+        import copy
+
+        if not any(c.params.expand for c in sg.children):
+            return
+        new_children: List[SubGraph] = []
+        for c in sg.children:
+            if not c.params.expand:
+                new_children.append(c)
+                continue
+            if c.params.expand == "_all_":
+                preds = [p for p in self.store.predicates() if not p.startswith("_")]
+            else:
+                vmap = value_vars.get(c.params.expand, {})
+                names = set()
+                for tv in vmap.values():
+                    v = tv.value
+                    names.update(v if isinstance(v, list) else [v])
+                preds = sorted(names)
+            for pr in preds:
+                nc = SubGraph(attr=pr)
+                nc.children = [copy.deepcopy(g) for g in c.children]
+                new_children.append(nc)
+        sg.children = new_children
+
+    def _exec_child(self, child: SubGraph, src: np.ndarray, resolver, uid_vars, value_vars):
+        self._exec_child_inner(child, src, resolver, uid_vars, value_vars)
+        # bind vars immediately: later siblings (math, aggregations) and
+        # later blocks read them (populateVarMap happens per-node in the
+        # reference too, query/query.go:1755 assignVars)
+        self._bind_var(child, uid_vars, value_vars)
+
+    def _bind_var(self, sg: SubGraph, uid_vars, value_vars):
+        p = sg.params
+        if p.var:
+            if sg.counts is not None:
+                value_vars[p.var] = {
+                    int(u): TypedValue(TypeID.INT, int(c))
+                    for u, c in zip(sg.src_uids.tolist(), sg.counts.tolist())
+                }
+            elif sg.values:
+                value_vars[p.var] = dict(sg.values)
+            elif len(sg.dest_uids):
+                uid_vars[p.var] = sg.dest_uids
+            else:
+                uid_vars.setdefault(p.var, _EMPTY)
+        if p.facets and p.facets.aliases and sg.edge_facets:
+            for key, var in p.facets.aliases.items():
+                m = {}
+                for (s, d), fs in sg.edge_facets.items():
+                    if key in fs:
+                        m[int(d)] = fs[key]
+                value_vars[var] = m
+
+    def _exec_child_inner(self, child: SubGraph, src: np.ndarray, resolver, uid_vars, value_vars):
+        attr = child.attr
+        p = child.params
+        if attr in ("_uid_", "uid", ""):
+            child.src_uids = src
+            return
+        if attr == "val":
+            # val(x) fetch: values come from the variable map
+            v = child.needs_var[0] if child.needs_var else ""
+            vmap = value_vars.get(v, {})
+            child.src_uids = src
+            child.values = {int(u): vmap[int(u)] for u in src.tolist() if int(u) in vmap}
+            if p.agg_func:
+                self._aggregate(child, src, value_vars)
+            return
+        if attr == "math":
+            child.src_uids = src
+            child.values = self._eval_math(child.math_exp, src, value_vars)
+            return
+        if attr == "_predicate_":
+            child.src_uids = src
+            child.values = {
+                int(u): TypedValue(
+                    TypeID.STRING,
+                    [pr for pr in self.store.predicates()
+                     if int(u) in self.store.pred(pr).uids_with_data()],
+                )
+                for u in src.tolist()
+            }
+            return
+        if child.func is not None and child.func.name == "checkpwd":
+            child.src_uids = src
+            ok = resolver.resolve(child.func, src)
+            okset = set(ok.tolist())
+            child.values = {
+                int(u): TypedValue(TypeID.BOOL, int(u) in okset) for u in src.tolist()
+            }
+            return
+
+        tid = self.store.schema.type_of(attr)
+        is_uid_pred = tid == TypeID.UID or (
+            self.store.peek(attr) is not None and bool(self.store.pred(attr).edges)
+        )
+
+        if p.do_count:
+            arena = self.arenas.reverse(attr) if child.reverse else self.arenas.data(attr)
+            rows = arena.rows_for_uids_host(src)
+            child.src_uids = src
+            child.counts = arena.degree_of_rows(rows).astype(np.int64)
+            return
+
+        if not is_uid_pred:
+            # value leaf: fetch typed values for each src uid
+            child.src_uids = src
+            langs = child.langs or [""]
+            vals = {}
+            for u in src.tolist():
+                v = None
+                for l in langs:
+                    v = self.store.value(attr, int(u), l)
+                    if v is not None:
+                        break
+                if v is not None:
+                    vals[int(u)] = v
+            child.values = vals
+            pd = self.store.peek(attr)
+            if pd is not None and pd.value_facets and child.params.facets:
+                child.value_facets = {
+                    int(u): pd.value_facets[int(u)]
+                    for u in src.tolist()
+                    if int(u) in pd.value_facets
+                }
+            return
+
+        # uid expansion on device
+        arena = self.arenas.reverse(attr) if child.reverse else self.arenas.data(attr)
+        out_flat, seg_ptr = self._expand(arena, src)
+        child.src_uids = src
+        child.out_flat = out_flat
+        child.seg_ptr = seg_ptr
+        dest = np.unique(out_flat)
+
+        if child.filter is not None:
+            dest = self._apply_filter(child.filter, dest, resolver)
+            self._mask_matrix(child, dest)
+        self._load_edge_facets(child)
+        if child.params.facets_filter is not None:
+            self._apply_facet_filter(child)
+        self._order_and_paginate_child(child, value_vars)
+        child.dest_uids = np.unique(child.out_flat)
+
+        if p.is_groupby:
+            from dgraph_tpu.query.groupby import process_groupby
+
+            process_groupby(self, child, value_vars)
+            return
+        self._exec_children(child, resolver, uid_vars, value_vars)
+
+    def _expand(self, arena, src: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """One batched device gather for a whole level (the TPU replacement
+        for the reference's per-key loop, worker/task.go:287-440)."""
+        n = len(src)
+        if n == 0 or arena.n_edges == 0:
+            return _EMPTY, np.zeros(n + 1, dtype=np.int64)
+        rows = arena.rows_for_uids_host(src)
+        total = int(arena.degree_of_rows(rows).sum())
+        if total == 0:
+            return _EMPTY, np.zeros(n + 1, dtype=np.int64)
+        cap = ops.bucket(total)
+        out, seg, _t = ops.expand_csr(
+            arena.offsets, arena.dst, ops.pad_rows(rows, ops.bucket(n)), cap
+        )
+        out = np.asarray(out[:total], dtype=np.int64)
+        seg = np.asarray(seg[:total], dtype=np.int64)
+        counts = np.bincount(seg, minlength=n)
+        seg_ptr = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(counts, out=seg_ptr[1:])
+        return out, seg_ptr
+
+    # -- filters -----------------------------------------------------------
+
+    def _apply_filter(self, ft: FilterTree, candidates: np.ndarray, resolver) -> np.ndarray:
+        if ft.func is not None:
+            return resolver.resolve(ft.func, candidates)
+        if ft.op == "and":
+            out = candidates
+            for c in ft.children:
+                out = self._apply_filter(c, out, resolver)
+            return out
+        if ft.op == "or":
+            parts = [self._apply_filter(c, candidates, resolver) for c in ft.children]
+            out = parts[0]
+            for s in parts[1:]:
+                out = np.union1d(out, s)
+            return out
+        if ft.op == "not":
+            sub = self._apply_filter(ft.children[0], candidates, resolver)
+            return np.setdiff1d(candidates, sub)
+        raise QueryError(f"bad filter op {ft.op!r}")
+
+    def _mask_matrix(self, sg: SubGraph, keep: np.ndarray):
+        """Filter out_flat to uids in ``keep`` (updateUidMatrix analog)."""
+        if len(sg.out_flat) == 0:
+            return
+        mask = np.isin(sg.out_flat, keep)
+        new_flat = sg.out_flat[mask]
+        counts = np.diff(sg.seg_ptr)
+        kept = np.zeros(len(counts), dtype=np.int64)
+        pos = 0
+        for i, c in enumerate(counts):
+            kept[i] = mask[pos : pos + c].sum()
+            pos += c
+        sg.seg_ptr = np.zeros(len(counts) + 1, dtype=np.int64)
+        np.cumsum(kept, out=sg.seg_ptr[1:])
+        sg.out_flat = new_flat
+
+    # -- facets ------------------------------------------------------------
+
+    def _load_edge_facets(self, sg: SubGraph):
+        pd = self.store.peek(sg.attr)
+        if pd is None or not pd.edge_facets:
+            return
+        if sg.params.facets is None and sg.params.facets_filter is None:
+            return
+        counts = np.diff(sg.seg_ptr)
+        owner = np.repeat(np.arange(len(counts)), counts)
+        for j, dst in enumerate(sg.out_flat.tolist()):
+            src = int(sg.src_uids[owner[j]])
+            key = (dst, src) if sg.reverse else (src, int(dst))
+            f = pd.edge_facets.get(key)
+            if f:
+                sg.edge_facets[(src, int(dst))] = f
+
+    def _apply_facet_filter(self, sg: SubGraph):
+        """@facets(eq(key, val)): keep edges whose facets satisfy the tree."""
+        tree = sg.params.facets_filter
+
+        def ok(facets: Dict[str, TypedValue], ft: FilterTree) -> bool:
+            if ft.func is not None:
+                fv = facets.get(ft.func.attr)
+                if fv is None:
+                    return False
+                from dgraph_tpu.models.types import compare_vals, convert
+
+                try:
+                    target = convert(TypedValue(TypeID.STRING, ft.func.args[0]), fv.tid)
+                except (ValueError, IndexError):
+                    return False
+                try:
+                    return compare_vals(ft.func.name, fv, target)
+                except ValueError:
+                    return False
+            if ft.op == "and":
+                return all(ok(facets, c) for c in ft.children)
+            if ft.op == "or":
+                return any(ok(facets, c) for c in ft.children)
+            if ft.op == "not":
+                return not ok(facets, ft.children[0])
+            return False
+
+        counts = np.diff(sg.seg_ptr)
+        owner = np.repeat(np.arange(len(counts)), counts)
+        mask = np.zeros(len(sg.out_flat), dtype=bool)
+        for j, dst in enumerate(sg.out_flat.tolist()):
+            src = int(sg.src_uids[owner[j]])
+            mask[j] = ok(sg.edge_facets.get((src, int(dst)), {}), tree)
+        kept = np.zeros(len(counts), dtype=np.int64)
+        pos = 0
+        for i, c in enumerate(counts):
+            kept[i] = mask[pos : pos + c].sum()
+            pos += c
+        sg.out_flat = sg.out_flat[mask]
+        sg.seg_ptr = np.zeros(len(counts) + 1, dtype=np.int64)
+        np.cumsum(kept, out=sg.seg_ptr[1:])
+
+    # -- order & pagination --------------------------------------------------
+
+    def _value_key_fn(self, attr: str, langs: List[str], value_vars, is_var: bool):
+        if is_var:
+            vmap = value_vars.get(attr, {})
+
+            def key(u: int):
+                v = vmap.get(u)
+                return sort_key(v) if v is not None else (9,)
+
+            return key
+
+        def key(u: int):
+            v = None
+            for l in langs or [""]:
+                v = self.store.value(attr, u, l)
+                if v is not None:
+                    break
+            return sort_key(v) if v is not None else (9,)
+
+        return key
+
+    def _order_and_paginate_root(self, sg: SubGraph, dest: np.ndarray, value_vars) -> np.ndarray:
+        p = sg.params
+        if p.after:
+            dest = dest[dest > p.after]
+        if p.order_attr:
+            key = self._value_key_fn(p.order_attr, p.order_langs, value_vars, p.order_is_var)
+            lst = sorted(dest.tolist(), key=key, reverse=p.order_desc)
+            dest = np.array(lst, dtype=np.int64)
+        dest = _paginate(dest, p.offset, p.first)
+        return dest
+
+    def _order_and_paginate_child(self, sg: SubGraph, value_vars):
+        p = sg.params
+        if not (p.first or p.offset or p.after or p.order_attr or
+                (p.facets and p.facets.order_key)):
+            return
+        key = None
+        if p.order_attr:
+            key = self._value_key_fn(p.order_attr, p.order_langs, value_vars, p.order_is_var)
+        counts = np.diff(sg.seg_ptr)
+        rows: List[np.ndarray] = []
+        pos = 0
+        for i, c in enumerate(counts):
+            row = sg.out_flat[pos : pos + c]
+            pos += c
+            if p.after:
+                row = row[row > p.after]
+            if p.facets and p.facets.order_key:
+                src = int(sg.src_uids[i])
+                fkey = p.facets.order_key
+
+                def fk(u: int):
+                    f = sg.edge_facets.get((src, int(u)), {})
+                    v = f.get(fkey)
+                    return sort_key(v) if v is not None else (9,)
+
+                row = np.array(
+                    sorted(row.tolist(), key=fk, reverse=p.facets.order_desc),
+                    dtype=np.int64,
+                )
+            elif key is not None:
+                row = np.array(
+                    sorted(row.tolist(), key=key, reverse=p.order_desc),
+                    dtype=np.int64,
+                )
+            row = _paginate(row, p.offset, p.first)
+            rows.append(row)
+        sg.out_flat = (
+            np.concatenate(rows) if rows else _EMPTY
+        )
+        sg.seg_ptr = np.zeros(len(counts) + 1, dtype=np.int64)
+        np.cumsum([len(r) for r in rows], out=sg.seg_ptr[1:])
+
+    # -- vars / aggregation / math -------------------------------------------
+
+    def _collect_vars(self, sg: SubGraph, uid_vars, value_vars):
+        self._bind_var(sg, uid_vars, value_vars)
+        for c in sg.children:
+            self._collect_vars(c, uid_vars, value_vars)
+
+    def _aggregate(self, child: SubGraph, src: np.ndarray, value_vars):
+        """min/max/sum/avg over a value variable (valueVarAggregation)."""
+        v = child.needs_var[0] if child.needs_var else ""
+        vmap = value_vars.get(v, {})
+        nums = [numeric(tv) for tv in vmap.values()]
+        nums = [x for x in nums if x is not None]
+        if not nums:
+            child.values = {}
+            return
+        fn = child.params.agg_func
+        if fn == "min":
+            r = min(nums)
+        elif fn == "max":
+            r = max(nums)
+        elif fn == "sum":
+            r = sum(nums)
+        else:
+            r = sum(nums) / len(nums)
+        tv = TypedValue(TypeID.FLOAT, float(r))
+        # one value for the block (reference emits it on the block root)
+        child.values = {int(u): tv for u in src.tolist()} or {0: tv}
+        if child.params.var:
+            value_vars[child.params.var] = dict(child.values)
+
+    def _eval_math(self, mt: MathTree, src: np.ndarray, value_vars) -> Dict[int, TypedValue]:
+        """Evaluate math() per uid over the value-variable environment
+        (query/math.go evalMathTree)."""
+        uids = set()
+        self._math_uids(mt, value_vars, uids)
+        if not uids:
+            uids = {int(u) for u in src.tolist()}
+        out = {}
+        for u in sorted(uids):
+            try:
+                val = _eval_math_at(mt, u, value_vars)
+            except (KeyError, ZeroDivisionError, ValueError, OverflowError):
+                continue
+            out[u] = TypedValue(TypeID.FLOAT, float(val))
+        return out
+
+    def _math_uids(self, mt: MathTree, value_vars, acc: set):
+        if mt.var and mt.var in value_vars:
+            acc.update(value_vars[mt.var].keys())
+        for c in mt.children:
+            self._math_uids(c, value_vars, acc)
+
+    # -- schema introspection -------------------------------------------------
+
+    def _schema_response(self, req) -> List[dict]:
+        preds = req.predicates or self.store.schema.predicates()
+        fields = req.fields or ["type"]
+        out = []
+        for pr in preds:
+            s = self.store.schema.peek(pr)
+            if s is None:
+                continue
+            item = {"predicate": pr}
+            for f in fields:
+                if f == "type":
+                    item["type"] = s.tid.name.lower()
+                elif f == "index":
+                    item["index"] = bool(s.tokenizers)
+                elif f == "tokenizer":
+                    item["tokenizer"] = list(s.tokenizers)
+                elif f == "reverse":
+                    item["reverse"] = s.reverse
+                elif f == "count":
+                    item["count"] = s.count
+            out.append(item)
+        return out
+
+
+def _paginate(arr: np.ndarray, offset: int, first: int) -> np.ndarray:
+    """first/offset windowing (x.PageRange analog: negative first = from
+    the end)."""
+    n = len(arr)
+    if offset > 0:
+        arr = arr[min(offset, n):]
+    if first > 0:
+        arr = arr[:first]
+    elif first < 0:
+        arr = arr[first:]
+    return arr
+
+
+_MATH_BIN = {
+    "+": lambda a, b: a + b,
+    "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b,
+    "/": lambda a, b: a / b,
+    "%": lambda a, b: pymath.fmod(a, b),
+    "<": lambda a, b: a < b,
+    ">": lambda a, b: a > b,
+    "<=": lambda a, b: a <= b,
+    ">=": lambda a, b: a >= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "pow": lambda a, b: a ** b,
+    "logbase": lambda a, b: pymath.log(a, b),
+}
+
+
+def _eval_math_at(mt: MathTree, uid: int, value_vars) -> float:
+    if mt.var:
+        v = value_vars.get(mt.var, {}).get(uid)
+        if v is None:
+            raise KeyError(mt.var)
+        x = numeric(v)
+        if x is None:
+            raise ValueError("non-numeric value in math")
+        return x
+    if mt.const is not None:
+        return mt.const
+    fn = mt.fn
+    kids = [_eval_math_at(c, uid, value_vars) for c in mt.children]
+    if fn in _MATH_BIN and len(kids) == 2:
+        return _MATH_BIN[fn](kids[0], kids[1])
+    if fn == "u-":
+        return -kids[0]
+    if fn == "exp":
+        return pymath.exp(kids[0])
+    if fn == "ln":
+        return pymath.log(kids[0])
+    if fn == "sqrt":
+        return pymath.sqrt(kids[0])
+    if fn == "floor":
+        return pymath.floor(kids[0])
+    if fn == "ceil":
+        return pymath.ceil(kids[0])
+    if fn == "since":
+        import time
+
+        return time.time() - kids[0]
+    if fn == "max":
+        return max(kids)
+    if fn == "min":
+        return min(kids)
+    if fn == "cond":
+        return kids[1] if kids[0] else kids[2]
+    raise ValueError(f"unknown math fn {fn!r}")

@@ -1,0 +1,72 @@
+"""@groupby execution (query/groupby.go processGroupBy:194).
+
+Groups the node's expanded destination uids by the value (or target uid)
+of the groupby attribute, then evaluates the node's children — count or
+aggregations — per group.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from dgraph_tpu.models.types import TypeID, TypedValue, numeric
+from dgraph_tpu.query.outputnode import json_value, _uid_hex
+from dgraph_tpu.query.subgraph import SubGraph
+
+
+def process_groupby(engine, sg: SubGraph, value_vars=None):
+    value_vars = value_vars or {}
+    dest = sg.dest_uids
+    groups: Dict[Tuple, dict] = {}
+    members: Dict[Tuple, List[int]] = {}
+
+    attrs = sg.params.groupby_attrs
+    for u in dest.tolist():
+        key_parts = []
+        disp = {}
+        for attr, lang in attrs:
+            pd = engine.store.peek(attr)
+            if pd is not None and pd.edges.get(int(u)):
+                # uid-valued groupby: group per target uid (first target)
+                for t in sorted(pd.edges[int(u)]):
+                    key_parts.append(("u", attr, t))
+                    disp[attr] = _uid_hex(t)
+                    break
+            else:
+                v = engine.store.value(attr, int(u), lang)
+                if v is None:
+                    key_parts.append(("v", attr, None))
+                else:
+                    key_parts.append(("v", attr, str(v.value)))
+                    disp[attr] = json_value(v)
+        key = tuple(key_parts)
+        if key not in groups:
+            groups[key] = disp
+            members[key] = []
+        members[key].append(int(u))
+
+    out = []
+    for key, disp in groups.items():
+        item = dict(disp)
+        for child in sg.children:
+            if child.params.do_count:
+                item["count"] = len(members[key])
+            elif child.params.agg_func and child.needs_var:
+                # aggregate a value var over group members
+                var = child.needs_var[0]
+                vmap = value_vars.get(var, {})
+                nums = [numeric(vmap[u]) for u in members[key] if u in vmap]
+                nums = [x for x in nums if x is not None]
+                if nums:
+                    fn = child.params.agg_func
+                    r = (
+                        min(nums) if fn == "min" else max(nums) if fn == "max"
+                        else sum(nums) if fn == "sum" else sum(nums) / len(nums)
+                    )
+                    item[child.alias or f"{fn}(val({var}))"] = float(r)
+        out.append(item)
+    # deterministic order: by the first group attr's display value
+    out.sort(key=lambda d: str(sorted(d.items())))
+    sg.groups = out

@@ -1,0 +1,3 @@
+"""RDF N-Quad parsing (equivalent of the reference's rdf/ package)."""
+
+from dgraph_tpu.rdf.parse import NQuad, ParseError, parse_line, parse_nquads  # noqa: F401

@@ -1,0 +1,3 @@
+"""Serving surface: mutation application, HTTP endpoints, bulk loading,
+export (equivalents of dgraph/ + cmd/dgraph + cmd/dgraphloader +
+worker/export.go)."""
