@@ -1,0 +1,230 @@
+"""Binary codec for durable records: varints + typed values + edges.
+
+The reference serializes postings/WAL entries as protobuf into Badger
+(posting/list.go SyncIfDirty, raftwal/wal.go).  Here the equivalent wire
+format is a hand-rolled varint codec shared by the WAL, snapshots and the
+bulk loader; the layout is deliberately language-neutral so the C++
+fast-path (native/) encodes/decodes the same bytes.
+
+Record payloads (first byte = record tag):
+
+  0x01 EDGE    flags pred src [dst | value] [lang] [facets]
+  0x02 SCHEMA  utf8 schema-language text
+  0x03 XID     xid-string uid
+  0x04 LEASE   next-uid
+  0x05 DELPRED pred
+
+Typed values: type byte (TypeID) + payload — zigzag varint for INT,
+8-byte LE double for FLOAT, raw byte for BOOL, length-prefixed utf8 for
+string-ish types, isoformat string for datetimes, GeoJSON string for GEO.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, Iterator, Optional, Tuple
+
+from dgraph_tpu.models.types import TypeID, TypedValue, parse_datetime
+
+EDGE = 0x01
+SCHEMA = 0x02
+XID = 0x03
+LEASE = 0x04
+DELPRED = 0x05
+
+_F_DEL = 1
+_F_VALUE = 2
+_F_FACETS = 4
+_F_LANG = 8
+
+
+# -- varints ----------------------------------------------------------------
+
+def put_uvarint(buf: bytearray, x: int) -> None:
+    while x >= 0x80:
+        buf.append((x & 0x7F) | 0x80)
+        x >>= 7
+    buf.append(x)
+
+
+def uvarint(b: bytes, pos: int) -> Tuple[int, int]:
+    x = 0
+    shift = 0
+    while True:
+        c = b[pos]
+        pos += 1
+        x |= (c & 0x7F) << shift
+        if c < 0x80:
+            return x, pos
+        shift += 7
+
+
+def put_varint(buf: bytearray, x: int) -> None:
+    put_uvarint(buf, (x << 1) ^ (x >> 63) if x < 0 else x << 1)
+
+
+def varint(b: bytes, pos: int) -> Tuple[int, int]:
+    u, pos = uvarint(b, pos)
+    return (u >> 1) ^ -(u & 1), pos
+
+
+def put_str(buf: bytearray, s: str) -> None:
+    raw = s.encode("utf-8")
+    put_uvarint(buf, len(raw))
+    buf.extend(raw)
+
+
+def get_str(b: bytes, pos: int) -> Tuple[str, int]:
+    n, pos = uvarint(b, pos)
+    return b[pos : pos + n].decode("utf-8"), pos + n
+
+
+# -- typed values -----------------------------------------------------------
+
+def put_value(buf: bytearray, v: TypedValue) -> None:
+    buf.append(int(v.tid))
+    t, val = v.tid, v.value
+    if t == TypeID.INT:
+        put_varint(buf, int(val))
+    elif t == TypeID.FLOAT:
+        buf.extend(struct.pack("<d", float(val)))
+    elif t == TypeID.BOOL:
+        buf.append(1 if val else 0)
+    elif t in (TypeID.DATETIME, TypeID.DATE):
+        put_str(buf, val.isoformat())
+    elif t == TypeID.GEO:
+        put_str(buf, json.dumps(val.to_geojson(), separators=(",", ":")))
+    elif t == TypeID.BINARY:
+        raw = bytes(val)
+        put_uvarint(buf, len(raw))
+        buf.extend(raw)
+    else:  # STRING / DEFAULT / PASSWORD / UID-as-str
+        put_str(buf, str(val))
+
+
+def get_value(b: bytes, pos: int) -> Tuple[TypedValue, int]:
+    t = TypeID(b[pos])
+    pos += 1
+    if t == TypeID.INT:
+        x, pos = varint(b, pos)
+        return TypedValue(t, x), pos
+    if t == TypeID.FLOAT:
+        (x,) = struct.unpack_from("<d", b, pos)
+        return TypedValue(t, x), pos + 8
+    if t == TypeID.BOOL:
+        return TypedValue(t, b[pos] != 0), pos + 1
+    if t in (TypeID.DATETIME, TypeID.DATE):
+        s, pos = get_str(b, pos)
+        return TypedValue(t, parse_datetime(s)), pos
+    if t == TypeID.GEO:
+        s, pos = get_str(b, pos)
+        from dgraph_tpu.models.geo import parse_geojson
+
+        return TypedValue(t, parse_geojson(s)), pos
+    if t == TypeID.BINARY:
+        n, pos = uvarint(b, pos)
+        return TypedValue(t, bytes(b[pos : pos + n])), pos + n
+    s, pos = get_str(b, pos)
+    return TypedValue(t, s), pos
+
+
+def put_facets(buf: bytearray, facets: Dict[str, TypedValue]) -> None:
+    put_uvarint(buf, len(facets))
+    for k in sorted(facets):
+        put_str(buf, k)
+        put_value(buf, facets[k])
+
+
+def get_facets(b: bytes, pos: int) -> Tuple[Dict[str, TypedValue], int]:
+    n, pos = uvarint(b, pos)
+    out = {}
+    for _ in range(n):
+        k, pos = get_str(b, pos)
+        v, pos = get_value(b, pos)
+        out[k] = v
+    return out, pos
+
+
+# -- records ----------------------------------------------------------------
+
+def encode_edge(e) -> bytes:
+    """Edge (models/store.py) → EDGE record payload."""
+    buf = bytearray([EDGE])
+    flags = 0
+    if e.op == "del":
+        flags |= _F_DEL
+    if e.value is not None:
+        flags |= _F_VALUE
+    if e.facets:
+        flags |= _F_FACETS
+    if e.lang:
+        flags |= _F_LANG
+    buf.append(flags)
+    put_str(buf, e.pred)
+    put_uvarint(buf, e.src)
+    if e.value is not None:
+        put_value(buf, e.value)
+    else:
+        put_uvarint(buf, e.dst)
+    if e.lang:
+        put_str(buf, e.lang)
+    if e.facets:
+        put_facets(buf, e.facets)
+    return bytes(buf)
+
+
+def decode_edge(b: bytes):
+    from dgraph_tpu.models.store import Edge
+
+    assert b[0] == EDGE
+    flags = b[1]
+    pos = 2
+    pred, pos = get_str(b, pos)
+    src, pos = uvarint(b, pos)
+    value = None
+    dst = 0
+    if flags & _F_VALUE:
+        value, pos = get_value(b, pos)
+    else:
+        dst, pos = uvarint(b, pos)
+    lang = ""
+    if flags & _F_LANG:
+        lang, pos = get_str(b, pos)
+    facets = None
+    if flags & _F_FACETS:
+        facets, pos = get_facets(b, pos)
+    return Edge(
+        pred=pred,
+        src=src,
+        dst=dst,
+        value=value,
+        lang=lang,
+        facets=facets,
+        op="del" if flags & _F_DEL else "set",
+    )
+
+
+def encode_schema(text: str) -> bytes:
+    buf = bytearray([SCHEMA])
+    put_str(buf, text)
+    return bytes(buf)
+
+
+def encode_xid(xid: str, uid: int) -> bytes:
+    buf = bytearray([XID])
+    put_str(buf, xid)
+    put_uvarint(buf, uid)
+    return bytes(buf)
+
+
+def encode_lease(next_uid: int) -> bytes:
+    buf = bytearray([LEASE])
+    put_uvarint(buf, next_uid)
+    return bytes(buf)
+
+
+def encode_delpred(pred: str) -> bytes:
+    buf = bytearray([DELPRED])
+    put_str(buf, pred)
+    return bytes(buf)

@@ -136,7 +136,7 @@ def parse_schema(text: str, into: Optional[SchemaState] = None) -> SchemaState:
     for lineno, line in enumerate(split_entries(text), 1):
         m = _LINE_RE.match(line)
         if not m:
-            raise ValueError(f"schema entry {lineno}: cannot parse {raw!r}")
+            raise ValueError(f"schema entry {lineno}: cannot parse {line!r}")
         name = m.group("name")
         tname = m.group("type").strip().strip("[]").strip()
         tid = type_from_name(tname)

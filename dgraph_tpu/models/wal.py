@@ -1,0 +1,262 @@
+"""Write-ahead log + snapshots: the durability layer.
+
+Equivalent of the reference's raftwal/wal.go (entry log in Badger) plus
+posting's dirty-sync contract (posting/lists.go:47-58: snapshots only up
+to the synced watermark).  Design: every mutation is appended to an
+append-only CRC-framed log *before* it is applied to the in-memory
+store; a snapshot is the compacted log — the full state re-encoded as
+the same record stream — written atomically, after which the WAL resets.
+Recovery = replay snapshot records, then WAL records; a torn tail (crash
+mid-append) is detected by CRC/length and truncated, like Badger's
+value-log replay.
+
+File layout in the store directory:
+  snapshot.bin   magic "DGTPSNP1" + record stream
+  wal.log        record stream
+Record framing: u32 payload-length | u32 crc32(payload) | payload.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Callable, Iterator, List, Optional
+
+from dgraph_tpu.models import codec
+from dgraph_tpu.models.schema import SchemaState, parse_schema
+from dgraph_tpu.models.store import Edge, PostingStore
+from dgraph_tpu.models.types import TypedValue
+from dgraph_tpu.models.uids import UidMap
+
+_MAGIC = b"DGTPSNP1"
+_HDR = struct.Struct("<II")
+
+
+class Wal:
+    """Append-only CRC-framed record log (raftwal analog)."""
+
+    def __init__(self, path: str, sync: bool = False):
+        self.path = path
+        self.sync = sync
+        self._f = open(path, "ab")
+        self.count = 0  # records appended this session
+
+    def append(self, payload: bytes) -> None:
+        self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+        self._f.write(payload)
+        self.count += 1
+
+    def flush(self) -> None:
+        self._f.flush()
+        if self.sync:
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self.flush()
+        self._f.close()
+
+    def reset(self) -> None:
+        """Truncate after a snapshot (wal.go entry truncation analog)."""
+        self._f.close()
+        self._f = open(self.path, "wb")
+        self.flush()
+        self.count = 0
+
+
+def replay_records(path: str, truncate_torn: bool = True) -> Iterator[bytes]:
+    """Yield record payloads; stop at (and optionally cut) a torn tail."""
+    if not os.path.exists(path):
+        return
+    good_end = 0
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    if data[: len(_MAGIC)] == _MAGIC:
+        pos = len(_MAGIC)
+    good_end = pos
+    n = len(data)
+    while pos + _HDR.size <= n:
+        length, crc = _HDR.unpack_from(data, pos)
+        start = pos + _HDR.size
+        end = start + length
+        if end > n:
+            break
+        payload = data[start:end]
+        if zlib.crc32(payload) != crc:
+            break
+        yield payload
+        pos = end
+        good_end = end
+    if truncate_torn and good_end < n:
+        with open(path, "r+b") as f:
+            f.truncate(good_end)
+
+
+class _JournaledUidMap(UidMap):
+    """UidMap that journals new xid assignments and lease movement."""
+
+    def __init__(self, journal: Callable[[bytes], None]):
+        super().__init__()
+        self._journal: Optional[Callable[[bytes], None]] = journal
+
+    def assign(self, xid: str) -> int:
+        known = xid in self._xid_to_uid
+        uid = super().assign(xid)
+        if not known and self._journal is not None:
+            self._journal(codec.encode_xid(xid, uid))
+        return uid
+
+    def fresh(self, n: int = 1) -> List[int]:
+        out = super().fresh(n)
+        if self._journal is not None:
+            self._journal(codec.encode_lease(self._next))
+        return out
+
+    def reserve_through(self, uid: int) -> None:
+        moved = uid >= self._next
+        super().reserve_through(uid)
+        if moved and self._journal is not None:
+            self._journal(codec.encode_lease(self._next))
+
+
+class DurableStore(PostingStore):
+    """PostingStore journaled to a WAL with atomic snapshots.
+
+    The write path mirrors the reference's raft-then-apply order
+    (worker/draft.go:514 processMutation → posting apply): journal
+    first, apply second, so recovery can always re-apply.
+    """
+
+    def __init__(self, directory: str, sync_writes: bool = False):
+        super().__init__()
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.snapshot_path = os.path.join(directory, "snapshot.bin")
+        self.wal_path = os.path.join(directory, "wal.log")
+        self._replaying = True
+        self._in_batch = False
+        self.applied_index = 0  # records applied (watermark analog)
+        # recover: snapshot stream, then wal stream
+        for payload in replay_records(self.snapshot_path, truncate_torn=False):
+            self._apply_record(payload)
+        for payload in replay_records(self.wal_path):
+            self._apply_record(payload)
+        self._replaying = False
+        self.wal = Wal(self.wal_path, sync=sync_writes)
+        self.uids = self._rebind_uids()
+
+    # -- journaling hooks ---------------------------------------------------
+
+    def _rebind_uids(self) -> UidMap:
+        jm = _JournaledUidMap(self._journal)
+        jm._xid_to_uid = self.uids._xid_to_uid
+        jm._next = self.uids._next
+        return jm
+
+    def _journal(self, payload: bytes) -> None:
+        if not self._replaying:
+            self.wal.append(payload)
+
+    def apply(self, e: Edge) -> None:
+        if e.op not in ("set", "del"):  # validate BEFORE journaling: a
+            # rejected mutation must not resurface from the WAL on restart
+            raise ValueError(f"unknown mutation op {e.op!r}")
+        self._journal(codec.encode_edge(e))
+        super().apply(e)
+        self.applied_index += 1
+        # an acknowledged single write must survive a process crash; batch
+        # paths flush once at the end (gentleCommit analog)
+        if not self._replaying and not self._in_batch:
+            self.wal.flush()
+
+    def apply_many(self, edges, flush: bool = True) -> int:
+        self._in_batch = True
+        try:
+            n = super().apply_many(edges)
+        finally:
+            self._in_batch = False
+        if flush and not self._replaying:
+            self.wal.flush()
+        return n
+
+    def apply_schema(self, text: str) -> None:
+        parse_schema(text, into=self.schema)  # validate before journaling
+        self._journal(codec.encode_schema(text))
+        self.applied_index += 1
+        if not self._replaying:
+            self.wal.flush()
+
+    def delete_predicate(self, pred: str) -> None:
+        self._journal(codec.encode_delpred(pred))
+        super().delete_predicate(pred)
+        self.applied_index += 1
+        if not self._replaying:
+            self.wal.flush()
+
+    # -- recovery -----------------------------------------------------------
+
+    def _apply_record(self, payload: bytes) -> None:
+        tag = payload[0]
+        if tag == codec.EDGE:
+            super().apply(codec.decode_edge(payload))
+        elif tag == codec.SCHEMA:
+            text, _ = codec.get_str(payload, 1)
+            parse_schema(text, into=self.schema)
+        elif tag == codec.XID:
+            xid, pos = codec.get_str(payload, 1)
+            uid, _ = codec.uvarint(payload, pos)
+            self.uids._xid_to_uid[xid] = uid
+            self.uids.reserve_through(uid)
+        elif tag == codec.LEASE:
+            nxt, _ = codec.uvarint(payload, 1)
+            self.uids.reserve_through(nxt - 1)
+        elif tag == codec.DELPRED:
+            pred, _ = codec.get_str(payload, 1)
+            super().delete_predicate(pred)
+        else:
+            raise ValueError(f"unknown WAL record tag {tag:#x}")
+        self.applied_index += 1
+
+    # -- snapshots ----------------------------------------------------------
+
+    def iter_state_records(self) -> Iterator[bytes]:
+        """Encode the full state as a record stream (compacted log).
+        Also the payload for replica catch-up (worker/predicate.go
+        populateShard analog) and RDF-free binary export."""
+        text = self.schema.to_text()
+        if text:
+            yield codec.encode_schema(text)
+        for xid, uid in sorted(self.uids.snapshot().items(), key=lambda kv: kv[1]):
+            yield codec.encode_xid(xid, uid)
+        yield codec.encode_lease(self.uids._next)
+        for pred in self.predicates():
+            pd = self.pred(pred)
+            for src in sorted(pd.edges):
+                for dst in sorted(pd.edges[src]):
+                    yield codec.encode_edge(
+                        Edge(pred=pred, src=src, dst=dst,
+                             facets=pd.edge_facets.get((src, dst)))
+                    )
+            for (src, lang) in sorted(pd.values):
+                yield codec.encode_edge(
+                    Edge(pred=pred, src=src, value=pd.values[(src, lang)],
+                         lang=lang, facets=pd.value_facets.get(src))
+                )
+
+    def snapshot(self) -> None:
+        """Atomically persist full state and reset the WAL
+        (draft.go:849 snapshot + wal truncation analog)."""
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_MAGIC)
+            for payload in self.iter_state_records():
+                f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+                f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snapshot_path)
+        self.wal.reset()
+
+    def close(self) -> None:
+        self.wal.close()

@@ -336,17 +336,7 @@ class QueryEngine:
         """Filter out_flat to uids in ``keep`` (updateUidMatrix analog)."""
         if len(sg.out_flat) == 0:
             return
-        mask = np.isin(sg.out_flat, keep)
-        new_flat = sg.out_flat[mask]
-        counts = np.diff(sg.seg_ptr)
-        kept = np.zeros(len(counts), dtype=np.int64)
-        pos = 0
-        for i, c in enumerate(counts):
-            kept[i] = mask[pos : pos + c].sum()
-            pos += c
-        sg.seg_ptr = np.zeros(len(counts) + 1, dtype=np.int64)
-        np.cumsum(kept, out=sg.seg_ptr[1:])
-        sg.out_flat = new_flat
+        _apply_edge_mask(sg, np.isin(sg.out_flat, keep))
 
     # -- facets ------------------------------------------------------------
 
@@ -398,14 +388,7 @@ class QueryEngine:
         for j, dst in enumerate(sg.out_flat.tolist()):
             src = int(sg.src_uids[owner[j]])
             mask[j] = ok(sg.edge_facets.get((src, int(dst)), {}), tree)
-        kept = np.zeros(len(counts), dtype=np.int64)
-        pos = 0
-        for i, c in enumerate(counts):
-            kept[i] = mask[pos : pos + c].sum()
-            pos += c
-        sg.out_flat = sg.out_flat[mask]
-        sg.seg_ptr = np.zeros(len(counts) + 1, dtype=np.int64)
-        np.cumsum(kept, out=sg.seg_ptr[1:])
+        _apply_edge_mask(sg, mask)
 
     # -- order & pagination --------------------------------------------------
 
@@ -559,6 +542,18 @@ class QueryEngine:
                     item["count"] = s.count
             out.append(item)
         return out
+
+
+def _apply_edge_mask(sg: SubGraph, mask: np.ndarray) -> None:
+    """Apply a per-edge boolean mask to (out_flat, seg_ptr) keeping the
+    segmented CSR consistent — the one shared place segment accounting
+    happens after filtering."""
+    counts = np.diff(sg.seg_ptr)
+    owner = np.repeat(np.arange(len(counts)), counts)
+    kept = np.bincount(owner[mask], minlength=len(counts))
+    sg.out_flat = sg.out_flat[mask]
+    sg.seg_ptr = np.zeros(len(counts) + 1, dtype=np.int64)
+    np.cumsum(kept, out=sg.seg_ptr[1:])
 
 
 def _paginate(arr: np.ndarray, offset: int, first: int) -> np.ndarray:

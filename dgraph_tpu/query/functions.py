@@ -295,11 +295,15 @@ class FuncResolver:
                 raise QueryError("near needs a distance argument")
             # candidate cells: the query point's ancestors plus neighbors
             # found via the coarse cells of an expanded bbox
-            d = max_m / 111_320.0  # meters per degree (approx)
+            import math as _m
+
+            dlat = max_m / 111_320.0  # meters per degree latitude
             lng, lat = q.coords
+            # longitude degrees shrink by cos(lat) away from the equator
+            dlng = dlat / max(_m.cos(_m.radians(lat)), 1e-6)
             ring = (
-                (lng - d, lat - d), (lng + d, lat - d),
-                (lng + d, lat + d), (lng - d, lat + d),
+                (lng - dlng, lat - dlat), (lng + dlng, lat - dlat),
+                (lng + dlng, lat + dlat), (lng - dlng, lat + dlat),
             )
             cells = geomod.polygon_cells(ring)
         else:
@@ -398,5 +402,11 @@ def _literal_runs(pattern: str) -> List[str]:
     conservative: strip groups/classes/escapes; runs must not merge
     across removed metacharacters (separator is \\x00, never space,
     since literals may contain spaces)."""
-    cleaned = re.sub(r"\\.|\[[^\]]*\]|\(\?[^)]*\)|[(){}|^$.*+?]", "\x00", pattern)
-    return [seg for seg in cleaned.split("\x00") if len(seg.strip()) >= 3]
+    s = re.sub(r"\\.|\[[^\]]*\]|\(\?[^)]*\)", "\x00", pattern)
+    # a char directly before *, ?, or {m,n} may occur zero times — it is
+    # NOT a required literal; drop it together with its quantifier
+    # (codesearch's RegexpQuery does the same cut)
+    s = re.sub(r".\{[^}]*\}", "\x00", s)
+    s = re.sub(r".[*?]", "\x00", s)
+    s = re.sub(r"[(){}|^$.*+?]", "\x00", s)
+    return [seg for seg in s.split("\x00") if len(seg.strip()) >= 3]

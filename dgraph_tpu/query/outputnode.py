@@ -188,7 +188,7 @@ def _normalize_flatten(store, sg: SubGraph, uid: int) -> Optional[List[dict]]:
             base[child.alias] = _uid_hex(uid)
     branch_lists: List[List[dict]] = []
     for child in sg.children:
-        if len(child.seg_ptr) > 1 and len(child.out_flat) is not None and child.children:
+        if (len(child.seg_ptr) > 1 or len(child.out_flat)) and child.children:
             i = _src_index(child, uid)
             if i < 0:
                 continue

@@ -31,10 +31,8 @@ def resolve_uid(store: PostingStore, ref: str, blanks: Dict[str, int]) -> int:
         u = int(ref, 16)
         store.uids.reserve_through(u)
         return u
-    if ref.isdigit():
-        u = int(ref)
-        store.uids.reserve_through(u)
-        return u
+    # NOTE: bare digits are a string xid, not an explicit uid — only 0x
+    # ids are literal uids (rdf/parse.go treats <123> as an external id)
     return store.uids.assign(ref)
 
 
@@ -82,7 +80,10 @@ def apply_mutation(store: PostingStore, mu: Mutation) -> Dict[str, int]:
     if mu.schema:
         from dgraph_tpu.models.schema import split_entries
 
-        parse_schema(mu.schema, into=store.schema)
+        if hasattr(store, "apply_schema"):
+            store.apply_schema(mu.schema)  # journaled (DurableStore)
+        else:
+            parse_schema(mu.schema, into=store.schema)
         # schema changes may alter index/reverse arenas for those preds
         for entry in split_entries(mu.schema):
             if ":" in entry:

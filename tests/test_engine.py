@@ -389,3 +389,15 @@ def test_lang_values(engine):
     assert got == {"q": [{"name@de": "Baum"}]}
     got = eng.run("{ q(func: uid(0x1)) { name } }")
     assert got == {"q": [{"name": "Tree"}]}
+
+
+def test_regexp_star_quantifier_not_pruned(engine):
+    # /Grimes*/ must match "Rick Grimes" (the 's' is optional, so 'mes'
+    # trigrams from the run are NOT all required); regression for unsound
+    # trigram pruning of * and {m,n} quantifiers
+    got = engine.run('{ me(func: regexp(name, /Grime[sz]*/)) { name } }')
+    assert got == {"me": [{"name": "Rick Grimes"}]}
+    got = engine.run('{ me(func: regexp(name, /Michonnes*/)) { name } }')
+    assert got == {"me": [{"name": "Michonne"}]}
+    got = engine.run('{ me(func: regexp(name, /Michonnes{0,2}/)) { name } }')
+    assert got == {"me": [{"name": "Michonne"}]}
