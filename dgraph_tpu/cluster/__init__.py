@@ -1,0 +1,13 @@
+"""Cluster layer: predicate sharding, membership, UID leasing, consensus.
+
+The TPU-native restructuring of the reference's group/ + worker/groups.go
++ worker/lease.go + worker/draft.go: predicates shard to groups (device
+mesh slices or hosts); a single metadata group (group 0) owns membership
+and the UID lease; replication is a Raft log per group feeding each
+replica's DurableStore.
+"""
+
+from dgraph_tpu.cluster.groups import GroupConfig, fingerprint64
+from dgraph_tpu.cluster.lease import LeaseManager
+
+__all__ = ["GroupConfig", "fingerprint64", "LeaseManager"]

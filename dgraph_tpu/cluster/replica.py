@@ -1,0 +1,143 @@
+"""A replicated store group: Raft log → PostingStore replicas.
+
+Equivalent of the reference's per-group stack (worker/draft.go
+processMutation → runMutations → posting apply): mutations are encoded
+as codec record batches, proposed through the group's Raft node, and
+applied to every replica's store when committed.  The Raft log IS the
+durability layer here (the reference similarly persists raft WAL +
+posting store; our snapshot = the store state record-stream, so a
+restarted or lagging replica restores from it and replays the log
+suffix — retrieveSnapshot, draft.go:679).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from typing import Dict, List, Optional
+
+from dgraph_tpu.models import codec
+from dgraph_tpu.models.store import Edge, PostingStore
+from dgraph_tpu.models.wal import apply_record, iter_state_records
+from dgraph_tpu.cluster.raft import RaftNode, RaftStorage, Transport
+
+_HDR = struct.Struct("<II")
+
+
+def encode_batch(records: List[bytes]) -> bytes:
+    buf = bytearray()
+    codec.put_uvarint(buf, len(records))
+    for r in records:
+        codec.put_uvarint(buf, len(r))
+        buf.extend(r)
+    return bytes(buf)
+
+
+def decode_batch(data: bytes) -> List[bytes]:
+    n, pos = codec.uvarint(data, 0)
+    out = []
+    for _ in range(n):
+        ln, pos = codec.uvarint(data, pos)
+        out.append(data[pos : pos + ln])
+        pos += ln
+    return out
+
+
+def state_to_bytes(store: PostingStore) -> bytes:
+    """Full store state as CRC-framed record stream (snapshot payload)."""
+    buf = bytearray()
+    for payload in iter_state_records(store):
+        buf.extend(_HDR.pack(len(payload), zlib.crc32(payload)))
+        buf.extend(payload)
+    return bytes(buf)
+
+
+def bytes_to_state(data: bytes, store: PostingStore) -> None:
+    """Replace store contents from a snapshot payload."""
+    store._preds.clear()
+    store.uids._xid_to_uid.clear()
+    store.uids._next = 1
+    store.dirty.add("*")
+    pos = 0
+    n = len(data)
+    while pos + _HDR.size <= n:
+        length, crc = _HDR.unpack_from(data, pos)
+        start = pos + _HDR.size
+        payload = data[start : start + length]
+        if zlib.crc32(payload) != crc:
+            raise ValueError("corrupt snapshot payload")
+        apply_record(store, payload)
+        pos = start + length
+
+
+class ReplicatedGroup:
+    """One server's replica of one group (draft.go node + its store)."""
+
+    def __init__(
+        self,
+        node_id: str,
+        group: int,
+        peers: List[str],
+        directory: str,
+        transport: Transport,
+        sync_writes: bool = False,
+        **raft_opts,
+    ):
+        self.store = PostingStore()
+        self.group = group
+        self._lock = threading.Lock()  # guards store during apply/snapshot
+        storage = RaftStorage(
+            os.path.join(directory, f"raft-g{group}"), sync=sync_writes
+        )
+        self.node = RaftNode(
+            node_id=node_id,
+            group=group,
+            peers=peers,
+            storage=storage,
+            transport=transport,
+            apply_fn=self._apply_committed,
+            snapshot_fn=self._snapshot_state,
+            restore_fn=self._restore_state,
+            **raft_opts,
+        )
+
+    def start(self) -> None:
+        self.node.start()
+
+    def stop(self) -> None:
+        self.node.stop()
+
+    # -- raft callbacks (loop thread) ---------------------------------------
+
+    def _apply_committed(self, index: int, data: bytes) -> None:
+        with self._lock:
+            for payload in decode_batch(data):
+                apply_record(self.store, payload)
+
+    def _snapshot_state(self) -> bytes:
+        with self._lock:
+            return state_to_bytes(self.store)
+
+    def _restore_state(self, data: bytes) -> None:
+        if not data:
+            return
+        with self._lock:
+            bytes_to_state(data, self.store)
+
+    # -- public write path ---------------------------------------------------
+
+    def propose_edges(self, edges: List[Edge], timeout: float = 10.0) -> None:
+        """MutateOverNetwork's per-group proposeOrSend (mutation.go:319)."""
+        self.node.propose_and_wait(
+            encode_batch([codec.encode_edge(e) for e in edges]), timeout
+        )
+
+    def propose_schema(self, text: str, timeout: float = 10.0) -> None:
+        self.node.propose_and_wait(
+            encode_batch([codec.encode_schema(text)]), timeout
+        )
+
+    def propose_records(self, records: List[bytes], timeout: float = 10.0) -> None:
+        self.node.propose_and_wait(encode_batch(records), timeout)

@@ -200,6 +200,13 @@ class ArenaManager:
         dirty = self.store.dirty
         if not dirty:
             return
+        if "*" in dirty:  # full-store replacement (snapshot restore)
+            self._data.clear()
+            self._reverse.clear()
+            self._values.clear()
+            self._index.clear()
+            dirty.clear()
+            return
         for p in list(dirty):
             for key in [k for k in self._data if k == p or k.startswith(p + "\x00")]:
                 self._data.pop(key, None)

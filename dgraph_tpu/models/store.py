@@ -144,6 +144,13 @@ class PostingStore:
             n += 1
         return n
 
+    def apply_schema(self, text: str) -> None:
+        """Parse schema text into this store's schema state; journaled
+        subclasses override (schema mutations, worker/mutation.go:94)."""
+        from dgraph_tpu.models.schema import parse_schema
+
+        parse_schema(text, into=self.schema)
+
     def delete_predicate(self, pred: str) -> None:
         """posting.DeletePredicate analog (posting/index.go:666)."""
         self._preds.pop(pred, None)

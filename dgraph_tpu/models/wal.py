@@ -64,8 +64,13 @@ class Wal:
         self.count = 0
 
 
-def replay_records(path: str, truncate_torn: bool = True) -> Iterator[bytes]:
-    """Yield record payloads; stop at (and optionally cut) a torn tail."""
+def replay_records(
+    path: str, truncate_torn: bool = True, strict: bool = False
+) -> Iterator[bytes]:
+    """Yield record payloads; stop at (and optionally cut) a torn tail.
+    ``strict`` raises instead — for atomically-written files (snapshots)
+    where a bad record is corruption, not a crash artifact, and loading
+    a partial state would silently lose data."""
     if not os.path.exists(path):
         return
     good_end = 0
@@ -81,9 +86,13 @@ def replay_records(path: str, truncate_torn: bool = True) -> Iterator[bytes]:
         start = pos + _HDR.size
         end = start + length
         if end > n:
+            if strict:
+                raise ValueError(f"{path}: truncated record at offset {pos}")
             break
         payload = data[start:end]
         if zlib.crc32(payload) != crc:
+            if strict:
+                raise ValueError(f"{path}: CRC mismatch at offset {pos}")
             break
         yield payload
         pos = end
@@ -91,6 +100,56 @@ def replay_records(path: str, truncate_torn: bool = True) -> Iterator[bytes]:
     if truncate_torn and good_end < n:
         with open(path, "r+b") as f:
             f.truncate(good_end)
+
+
+def apply_record(store: PostingStore, payload: bytes) -> None:
+    """Apply one record to a store WITHOUT journaling — used for WAL/
+    snapshot replay, Raft committed-entry application, and replica
+    catch-up (the processMutation → posting apply path, draft.go:514)."""
+    tag = payload[0]
+    if tag == codec.EDGE:
+        PostingStore.apply(store, codec.decode_edge(payload))
+    elif tag == codec.SCHEMA:
+        text, _ = codec.get_str(payload, 1)
+        parse_schema(text, into=store.schema)
+    elif tag == codec.XID:
+        xid, pos = codec.get_str(payload, 1)
+        uid, _ = codec.uvarint(payload, pos)
+        store.uids._xid_to_uid[xid] = uid
+        store.uids.reserve_through(uid)
+    elif tag == codec.LEASE:
+        nxt, _ = codec.uvarint(payload, 1)
+        store.uids.reserve_through(nxt - 1)
+    elif tag == codec.DELPRED:
+        pred, _ = codec.get_str(payload, 1)
+        PostingStore.delete_predicate(store, pred)
+    else:
+        raise ValueError(f"unknown WAL record tag {tag:#x}")
+
+
+def iter_state_records(store: PostingStore):
+    """Encode a store's full state as a record stream (compacted log).
+    Used for snapshots, replica catch-up (worker/predicate.go
+    populateShard analog) and binary export."""
+    text = store.schema.to_text()
+    if text:
+        yield codec.encode_schema(text)
+    for xid, uid in sorted(store.uids.snapshot().items(), key=lambda kv: kv[1]):
+        yield codec.encode_xid(xid, uid)
+    yield codec.encode_lease(store.uids._next)
+    for pred in store.predicates():
+        pd = store.pred(pred)
+        for src in sorted(pd.edges):
+            for dst in sorted(pd.edges[src]):
+                yield codec.encode_edge(
+                    Edge(pred=pred, src=src, dst=dst,
+                         facets=pd.edge_facets.get((src, dst)))
+                )
+        for (src, lang) in sorted(pd.values):
+            yield codec.encode_edge(
+                Edge(pred=pred, src=src, value=pd.values[(src, lang)],
+                     lang=lang, facets=pd.value_facets.get(src))
+            )
 
 
 class _JournaledUidMap(UidMap):
@@ -138,10 +197,14 @@ class DurableStore(PostingStore):
         self._in_batch = False
         self.applied_index = 0  # records applied (watermark analog)
         # recover: snapshot stream, then wal stream
-        for payload in replay_records(self.snapshot_path, truncate_torn=False):
-            self._apply_record(payload)
+        for payload in replay_records(
+            self.snapshot_path, truncate_torn=False, strict=True
+        ):
+            apply_record(self, payload)
+            self.applied_index += 1
         for payload in replay_records(self.wal_path):
-            self._apply_record(payload)
+            apply_record(self, payload)
+            self.applied_index += 1
         self._replaying = False
         self.wal = Wal(self.wal_path, sync=sync_writes)
         self.uids = self._rebind_uids()
@@ -149,7 +212,7 @@ class DurableStore(PostingStore):
     # -- journaling hooks ---------------------------------------------------
 
     def _rebind_uids(self) -> UidMap:
-        jm = _JournaledUidMap(self._journal)
+        jm = _JournaledUidMap(self._journal_durable)
         jm._xid_to_uid = self.uids._xid_to_uid
         jm._next = self.uids._next
         return jm
@@ -157,6 +220,31 @@ class DurableStore(PostingStore):
     def _journal(self, payload: bytes) -> None:
         if not self._replaying:
             self.wal.append(payload)
+
+    def _journal_durable(self, payload: bytes) -> None:
+        """Journal + flush: uid handouts must be durable before the uid is
+        visible to a client, or a crash re-issues it and a new entity
+        aliases the old one's postings (lease.py's contract)."""
+        if not self._replaying:
+            self.wal.append(payload)
+            if not self._in_batch:
+                self.wal.flush()
+
+    def batch(self):
+        """Context manager deferring WAL flushes to the end of a multi-
+        record operation (gentle-commit batching, posting/lists.go:109)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            self._in_batch = True
+            try:
+                yield self
+            finally:
+                self._in_batch = False
+                self.wal.flush()
+
+        return _cm()
 
     def apply(self, e: Edge) -> None:
         if e.op not in ("set", "del"):  # validate BEFORE journaling: a
@@ -194,55 +282,10 @@ class DurableStore(PostingStore):
         if not self._replaying:
             self.wal.flush()
 
-    # -- recovery -----------------------------------------------------------
-
-    def _apply_record(self, payload: bytes) -> None:
-        tag = payload[0]
-        if tag == codec.EDGE:
-            super().apply(codec.decode_edge(payload))
-        elif tag == codec.SCHEMA:
-            text, _ = codec.get_str(payload, 1)
-            parse_schema(text, into=self.schema)
-        elif tag == codec.XID:
-            xid, pos = codec.get_str(payload, 1)
-            uid, _ = codec.uvarint(payload, pos)
-            self.uids._xid_to_uid[xid] = uid
-            self.uids.reserve_through(uid)
-        elif tag == codec.LEASE:
-            nxt, _ = codec.uvarint(payload, 1)
-            self.uids.reserve_through(nxt - 1)
-        elif tag == codec.DELPRED:
-            pred, _ = codec.get_str(payload, 1)
-            super().delete_predicate(pred)
-        else:
-            raise ValueError(f"unknown WAL record tag {tag:#x}")
-        self.applied_index += 1
-
     # -- snapshots ----------------------------------------------------------
 
     def iter_state_records(self) -> Iterator[bytes]:
-        """Encode the full state as a record stream (compacted log).
-        Also the payload for replica catch-up (worker/predicate.go
-        populateShard analog) and RDF-free binary export."""
-        text = self.schema.to_text()
-        if text:
-            yield codec.encode_schema(text)
-        for xid, uid in sorted(self.uids.snapshot().items(), key=lambda kv: kv[1]):
-            yield codec.encode_xid(xid, uid)
-        yield codec.encode_lease(self.uids._next)
-        for pred in self.predicates():
-            pd = self.pred(pred)
-            for src in sorted(pd.edges):
-                for dst in sorted(pd.edges[src]):
-                    yield codec.encode_edge(
-                        Edge(pred=pred, src=src, dst=dst,
-                             facets=pd.edge_facets.get((src, dst)))
-                    )
-            for (src, lang) in sorted(pd.values):
-                yield codec.encode_edge(
-                    Edge(pred=pred, src=src, value=pd.values[(src, lang)],
-                         lang=lang, facets=pd.value_facets.get(src))
-                )
+        return iter_state_records(self)
 
     def snapshot(self) -> None:
         """Atomically persist full state and reset the WAL

@@ -403,9 +403,17 @@ def _literal_runs(pattern: str) -> List[str]:
     across removed metacharacters (separator is \\x00, never space,
     since literals may contain spaces)."""
     s = re.sub(r"\\.|\[[^\]]*\]|\(\?[^)]*\)", "\x00", pattern)
-    # a char directly before *, ?, or {m,n} may occur zero times — it is
-    # NOT a required literal; drop it together with its quantifier
-    # (codesearch's RegexpQuery does the same cut)
+    # anything directly before *, ?, or {m,n} may occur zero (or many)
+    # times — NOT a required literal; drop it with its quantifier
+    # (codesearch's RegexpQuery does the same cut).  Groups resolve
+    # innermost-first: a quantified group is dropped whole, a plain
+    # group is transparent for its contents but splits runs at its
+    # edges (conservative), iterated to a fixpoint for nesting.
+    prev = None
+    while prev != s:
+        prev = s
+        s = re.sub(r"\([^()]*\)(\{[^}]*\}|[*?+])", "\x00", s)
+        s = re.sub(r"\(([^()]*)\)", "\x00\\1\x00", s)
     s = re.sub(r".\{[^}]*\}", "\x00", s)
     s = re.sub(r".[*?]", "\x00", s)
     s = re.sub(r"[(){}|^$.*+?]", "\x00", s)

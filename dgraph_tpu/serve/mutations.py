@@ -13,7 +13,7 @@ from typing import Dict, List, Tuple
 
 from dgraph_tpu.gql.ast import Mutation
 from dgraph_tpu.models.password import hash_password
-from dgraph_tpu.models.schema import parse_schema
+
 from dgraph_tpu.models.store import Edge, PostingStore
 from dgraph_tpu.models.types import TypeID, TypedValue, convert
 from dgraph_tpu.rdf import NQuad, parse_nquads
@@ -80,10 +80,7 @@ def apply_mutation(store: PostingStore, mu: Mutation) -> Dict[str, int]:
     if mu.schema:
         from dgraph_tpu.models.schema import split_entries
 
-        if hasattr(store, "apply_schema"):
-            store.apply_schema(mu.schema)  # journaled (DurableStore)
-        else:
-            parse_schema(mu.schema, into=store.schema)
+        store.apply_schema(mu.schema)  # journaled when the store is durable
         # schema changes may alter index/reverse arenas for those preds
         for entry in split_entries(mu.schema):
             if ":" in entry:

@@ -1,0 +1,207 @@
+"""Cluster layer tests: sharding config, uid leases, Raft replication.
+
+Mirrors the reference's in-process multi-group pattern (SURVEY.md §4):
+real consensus, no network — InMemoryTransport plays the role of
+worker.Config.InMemoryComm.
+"""
+
+import time
+
+import pytest
+
+from dgraph_tpu.cluster.groups import GroupConfig, fingerprint64
+from dgraph_tpu.cluster.lease import LeaseManager
+from dgraph_tpu.cluster.raft import InMemoryTransport, NotLeaderError
+from dgraph_tpu.cluster.replica import ReplicatedGroup
+from dgraph_tpu.models.store import Edge
+
+
+def wait_for(cond, timeout=5.0, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+# -- groups -----------------------------------------------------------------
+
+def test_group_config_rules():
+    cfg = GroupConfig.parse(
+        """
+        # comment
+        1: name, film.*
+        2: friend
+        default: fp % 3 + 10
+        """
+    )
+    assert cfg.belongs_to("name") == 1
+    assert cfg.belongs_to("film.director") == 1
+    assert cfg.belongs_to("friend") == 2
+    g = cfg.belongs_to("other")
+    assert 10 <= g < 13
+    assert cfg.belongs_to("other") == g  # stable
+
+
+def test_group_config_default_only():
+    cfg = GroupConfig.single_group()
+    assert cfg.belongs_to("anything") == fingerprint64("anything") % 1 + 1 == 1
+
+
+def test_group_config_requires_default():
+    with pytest.raises(ValueError):
+        GroupConfig.parse("1: name")
+
+
+# -- lease ------------------------------------------------------------------
+
+def test_lease_batches_proposals():
+    calls = []
+    lm = LeaseManager(calls.append, min_lease=100)
+    s, e = lm.assign(5)
+    assert (s, e) == (1, 5)
+    assert calls == [101]
+    for _ in range(10):
+        lm.assign(9)
+    assert calls == [101]  # still under the first lease
+    lm.assign(50)
+    assert calls == [101, 201]
+
+
+def test_lease_recovery_never_reuses():
+    calls = []
+    lm = LeaseManager(calls.append, min_lease=100)
+    lm.assign(5)
+    # crash; recover from the durable lease record (uids < 101 may have
+    # been handed out)
+    lm2 = LeaseManager(calls.append, min_lease=100)
+    lm2.init_from_recovery(next_uid=101)
+    s, _ = lm2.assign(1)
+    assert s == 101
+
+
+# -- raft -------------------------------------------------------------------
+
+def _cluster(tmp_path, n=3, threshold=10_000):
+    tr = InMemoryTransport()
+    ids = [f"n{i}" for i in range(n)]
+    groups = []
+    for i in ids:
+        g = ReplicatedGroup(
+            node_id=i, group=1, peers=ids, directory=str(tmp_path / i),
+            transport=tr, snapshot_threshold=threshold,
+        )
+        tr.register(g.node)
+        groups.append(g)
+    for g in groups:
+        g.start()
+    return tr, groups
+
+
+def _leader(groups):
+    ls = [g for g in groups if g.node.is_leader]
+    return ls[0] if ls else None
+
+
+def test_raft_elects_and_replicates(tmp_path):
+    tr, groups = _cluster(tmp_path)
+    try:
+        assert wait_for(lambda: _leader(groups) is not None)
+        ld = _leader(groups)
+        ld.propose_edges([Edge(pred="p", src=1, dst=2)])
+        ld.propose_edges([Edge(pred="p", src=1, dst=3)])
+        assert wait_for(
+            lambda: all(g.store.neighbors("p", 1) == [2, 3] for g in groups)
+        )
+    finally:
+        for g in groups:
+            g.stop()
+
+
+def test_raft_follower_rejects_proposals(tmp_path):
+    tr, groups = _cluster(tmp_path)
+    try:
+        assert wait_for(lambda: _leader(groups) is not None)
+        follower = next(g for g in groups if not g.node.is_leader)
+        with pytest.raises(NotLeaderError):
+            follower.propose_edges([Edge(pred="p", src=1, dst=2)], timeout=2)
+    finally:
+        for g in groups:
+            g.stop()
+
+
+def test_raft_reelection_after_partition(tmp_path):
+    tr, groups = _cluster(tmp_path)
+    try:
+        assert wait_for(lambda: _leader(groups) is not None)
+        old = _leader(groups)
+        others = [g for g in groups if g is not old]
+        for g in others:
+            tr.cut(old.node.node_id, g.node.node_id)
+        assert wait_for(lambda: _leader(others) is not None, timeout=10)
+        new_leader = _leader(others)
+        new_leader.propose_edges([Edge(pred="q", src=7, dst=8)])
+        tr.heal()
+        # old leader steps down and catches up
+        assert wait_for(
+            lambda: all(g.store.neighbors("q", 7) == [8] for g in groups),
+            timeout=10,
+        )
+    finally:
+        for g in groups:
+            g.stop()
+
+
+def test_raft_restart_recovers_state(tmp_path):
+    tr, groups = _cluster(tmp_path, n=1)
+    try:
+        assert wait_for(lambda: _leader(groups) is not None)
+        groups[0].propose_edges([Edge(pred="p", src=1, dst=2)])
+    finally:
+        groups[0].stop()
+    tr2 = InMemoryTransport()
+    g = ReplicatedGroup(
+        node_id="n0", group=1, peers=["n0"], directory=str(tmp_path / "n0"),
+        transport=tr2,
+    )
+    tr2.register(g.node)
+    g.start()
+    try:
+        assert wait_for(lambda: g.node.is_leader, timeout=10)
+        assert wait_for(lambda: g.store.neighbors("p", 1) == [2])
+    finally:
+        g.stop()
+
+
+def test_raft_snapshot_catchup(tmp_path):
+    # small threshold so the log compacts, forcing snapshot install on a
+    # freshly-joined (empty-dir) replica
+    tr, groups = _cluster(tmp_path, n=2, threshold=5)
+    try:
+        assert wait_for(lambda: _leader(groups) is not None)
+        ld = _leader(groups)
+        for i in range(1, 12):
+            ld.propose_edges([Edge(pred="p", src=i, dst=i + 1)])
+        assert ld.node.storage.snap_index > 0  # compacted
+        # new replica joins with empty state; leader must ship a snapshot
+        g3 = ReplicatedGroup(
+            node_id="n9", group=1, peers=["n0", "n1", "n9"],
+            directory=str(tmp_path / "n9"), transport=tr,
+        )
+        tr.register(g3.node)
+        # make existing nodes aware of the new peer (static config join)
+        for g in groups:
+            g.node.peers.append("n9")
+            g.node.next_index["n9"] = g.node.storage.last_index() + 1
+            g.node.match_index["n9"] = 0
+        g3.start()
+        assert wait_for(
+            lambda: g3.store.neighbors("p", 1) == [2]
+            and g3.store.neighbors("p", 11) == [12],
+            timeout=10,
+        )
+    finally:
+        for g in groups:
+            g.stop()
+        g3.stop()

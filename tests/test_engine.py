@@ -401,3 +401,9 @@ def test_regexp_star_quantifier_not_pruned(engine):
     assert got == {"me": [{"name": "Michonne"}]}
     got = engine.run('{ me(func: regexp(name, /Michonnes{0,2}/)) { name } }')
     assert got == {"me": [{"name": "Michonne"}]}
+
+
+def test_regexp_group_quantifier_not_pruned(engine):
+    # (son)* — group contents are optional, must not be required trigrams
+    got = engine.run('{ me(func: regexp(name, /Rick(son)* Grimes/)) { name } }')
+    assert got == {"me": [{"name": "Rick Grimes"}]}
