@@ -1,0 +1,7 @@
+"""Command-line entry points.
+
+Equivalent of the reference's cmd/ tree:
+- ``python -m dgraph_tpu.cli.server``  ≈ cmd/dgraph (the server binary)
+- ``python -m dgraph_tpu.cli.loader``  ≈ cmd/dgraphloader (bulk RDF loader)
+- ``python -m dgraph_tpu.cli.posting_iterator`` ≈ cmd/postingiterator
+"""

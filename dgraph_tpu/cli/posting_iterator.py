@@ -1,0 +1,32 @@
+"""Debug tool: dump the posting store.
+
+Equivalent of cmd/postingiterator/main.go — iterate the persisted store
+and print each posting (predicate, uid, dst/value)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from dgraph_tpu.models.wal import DurableStore
+from dgraph_tpu.serve.export import iter_rdf_lines
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="posting-iterator", description=__doc__)
+    p.add_argument("--p", dest="postings_dir", default="p")
+    p.add_argument("--pred", default="", help="only this predicate")
+    ns = p.parse_args(argv)
+    store = DurableStore(ns.postings_dir)
+    try:
+        for line in iter_rdf_lines(store):
+            if ns.pred and f"<{ns.pred}>" not in line:
+                continue
+            print(line)
+    finally:
+        store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,81 @@
+"""The server binary.
+
+Equivalent of cmd/dgraph/main.go: flags (+ optional YAML config merge,
+setupConfigOpts:85), storage bring-up, HTTP surface, health gating, and
+a clean shutdown path.  The boot order mirrors main:675: open stores →
+schema/posting init (implicit in DurableStore) → serving surface →
+health OK.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+from dgraph_tpu.models.wal import DurableStore
+from dgraph_tpu.serve.server import DgraphServer
+from dgraph_tpu.utils.config import Options
+
+
+def build_options(argv=None) -> Options:
+    p = argparse.ArgumentParser(prog="dgraph-tpu", description=__doc__)
+    # YAML is applied BEFORE flags (cmd/dgraph/main.go:164-168): config
+    # values become the flag defaults, so explicit flags always win
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--config", default="")
+    pre_ns, _ = pre.parse_known_args(argv)
+    d = Options()
+    if pre_ns.config:
+        d = d.merged_with_yaml(pre_ns.config)
+    p.add_argument("--p", dest="postings_dir", default=d.postings_dir,
+                   help="directory to store posting state + snapshots")
+    p.add_argument("--w", dest="wal_dir", default=d.wal_dir,
+                   help="(reserved) separate wal dir; DurableStore keeps wal beside postings")
+    p.add_argument("--export", dest="export_path", default=d.export_path)
+    p.add_argument("--port", type=int, default=d.port)
+    p.add_argument("--bind", default=d.bind)
+    p.add_argument("--sync", dest="sync_writes", action="store_true")
+    p.add_argument("--idx", dest="raft_id", type=int, default=d.raft_id)
+    p.add_argument("--groups", dest="group_ids", default=d.group_ids)
+    p.add_argument("--peer", default=d.peer)
+    p.add_argument("--my", dest="my_addr", default=d.my_addr)
+    p.add_argument("--trace", dest="trace_ratio", type=float, default=d.trace_ratio)
+    p.add_argument("--expose_trace", action="store_true")
+    p.add_argument("--config", default="", help="YAML config file (flat key: value)")
+    ns = p.parse_args(argv)
+    return Options(**{k: getattr(ns, k) for k in vars(ns) if k != "config"})
+
+
+def main(argv=None) -> int:
+    opts = build_options(argv)
+    store = DurableStore(opts.postings_dir, sync_writes=opts.sync_writes)
+    srv = DgraphServer(
+        store,
+        port=opts.port,
+        bind=opts.bind,
+        export_path=opts.export_path,
+        trace_ratio=opts.trace_ratio,
+        expose_trace=opts.expose_trace,
+    )
+    srv.start()
+    print(f"dgraph-tpu serving at {srv.addr}  (dashboard at /, queries at /query)")
+
+    stop = {"requested": False}
+
+    def on_signal(signum, frame):
+        stop["requested"] = True
+        srv.stop()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    try:
+        while srv._thread is not None and srv._thread.is_alive():
+            srv._thread.join(timeout=0.5)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

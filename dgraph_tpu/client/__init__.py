@@ -1,0 +1,28 @@
+"""Client SDK: batching mutations, query, unmarshal, checkpointing.
+
+Equivalent of the reference's client/ package: `NewDgraphClient`-style
+batching client (client/mutations.go:206) with pipelined request workers
+(makeRequests:364), typed edge builders (client/client.go:266+), reflTag
+unmarshal (client/unmarshal.go:253), and per-source-file checkpoint
+watermarks for resumable bulk loads (client/checkpoint.go:29-95).
+"""
+
+from dgraph_tpu.client.client import (
+    BatchMutationOptions,
+    DgraphClient,
+    Edge as ClientEdge,
+    EmbeddedTransport,
+    HttpTransport,
+)
+from dgraph_tpu.client.checkpoint import SyncMarks
+from dgraph_tpu.client.unmarshal import unmarshal
+
+__all__ = [
+    "BatchMutationOptions",
+    "DgraphClient",
+    "ClientEdge",
+    "EmbeddedTransport",
+    "HttpTransport",
+    "SyncMarks",
+    "unmarshal",
+]

@@ -227,6 +227,12 @@ class RaftStorage:
             return []
         return self.entries[i:]
 
+    def entry_at(self, index: int) -> Optional[Entry]:
+        i = index - self.snap_index - 1
+        if 0 <= i < len(self.entries):
+            return self.entries[i]
+        return None
+
     def save_snapshot(self, index: int, term: int, data: bytes) -> None:
         """Install/record a snapshot and drop covered entries."""
         tmp = self._snap_path + ".tmp"
@@ -346,13 +352,26 @@ class RaftNode:
             try:
                 item = self._inbox.get(timeout=self.tick_s)
             except queue.Empty:
-                self._tick()
+                try:
+                    self._tick()
+                except Exception:  # noqa: BLE001
+                    import traceback
+
+                    traceback.print_exc()
                 continue
             kind = item[0]
-            if kind == "msg":
-                self._handle(item[1])
-            elif kind == "propose":
-                self._handle_propose(item[1], item[2])
+            try:
+                if kind == "msg":
+                    self._handle(item[1])
+                elif kind == "propose":
+                    self._handle_propose(item[1], item[2])
+            except Exception:  # noqa: BLE001 — a bad entry/storage error must
+                # not silently kill the event loop and wedge the group
+                import traceback
+
+                traceback.print_exc()
+                if kind == "propose" and not item[2].done():
+                    item[2].set_exception(RuntimeError("raft apply failed"))
 
     def _rand_timeout(self) -> int:
         return self.election_ticks + random.randrange(self.election_ticks)
@@ -612,8 +631,7 @@ class RaftNode:
         self.commit_index = idx
         while self.last_applied < self.commit_index:
             self.last_applied += 1
-            e = self.storage.entries_from(self.last_applied)
-            entry = e[0] if e else None
+            entry = self.storage.entry_at(self.last_applied)
             if entry is not None and entry.data:
                 self.apply_fn(entry.index, entry.data)
             fut = self._pending.pop(self.last_applied, None)

@@ -97,6 +97,9 @@ def replay_records(
         yield payload
         pos = end
         good_end = end
+    if strict and good_end < n:
+        # trailing garbage shorter than a header is still corruption
+        raise ValueError(f"{path}: trailing garbage at offset {good_end}")
     if truncate_torn and good_end < n:
         with open(path, "r+b") as f:
             f.truncate(good_end)

@@ -25,6 +25,15 @@ from dgraph_tpu.models.types import TypeID, TypedValue
 from dgraph_tpu.query.subgraph import SubGraph
 
 
+# ?debug=true attaches "_uid_" to every emitted node, as the reference's
+# queryHandler debug context does (cmd/dgraph/main.go:226)
+import contextvars
+
+DEBUG_UIDS: "contextvars.ContextVar[bool]" = contextvars.ContextVar(
+    "debug_uids", default=False
+)
+
+
 def _uid_hex(u: int) -> str:
     return hex(int(u))
 
@@ -169,6 +178,8 @@ def encode_node(
             cascade_fail = True
     if cascade_fail:
         return None
+    if DEBUG_UIDS.get() and obj:
+        obj.setdefault("_uid_", _uid_hex(uid))
     return obj
 
 

@@ -1,0 +1,269 @@
+"""HTTP serving surface.
+
+Equivalent of cmd/dgraph/main.go's handler set (queryHandler:226,
+shareHandler:391, exportHandler:499, shutdown:471, /health, /debug/store
+main.go:641-652) + dgraph/server.go's request loop (Run:104: parse →
+process → encode with latency map and 1-minute timeout).  The reference
+multiplexes gRPC + HTTP on one port via cmux; here one threaded HTTP
+server carries both the human JSON surface and the machine client
+(dgraph_tpu.client speaks the same /query endpoint, as the reference's
+HTTP clients do).  Engine execution is serialized by a lock — the arena
+is shared device state, and the reference likewise funnels device work
+through one ServeTask boundary per group (SURVEY.md §2c).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from dgraph_tpu.models.store import PostingStore
+from dgraph_tpu.query.engine import QueryEngine
+from dgraph_tpu.serve.export import export as export_rdf
+from dgraph_tpu.utils import HealthGate, Latency
+from dgraph_tpu.utils.metrics import (
+    NUM_QUERIES,
+    PENDING_QUERIES,
+    metrics,
+)
+from dgraph_tpu.utils.trace import Tracer
+
+_CORS = {
+    "Access-Control-Allow-Origin": "*",
+    "Access-Control-Allow-Methods": "POST, GET, OPTIONS",
+    "Access-Control-Allow-Headers": "Content-Type",
+    "Connection": "close",
+}
+
+
+class DgraphServer:
+    """Owns the store + engine and serves the HTTP surface."""
+
+    def __init__(
+        self,
+        store: PostingStore,
+        port: int = 0,
+        bind: str = "127.0.0.1",
+        export_path: str = "export",
+        trace_ratio: float = 0.0,
+        expose_trace: bool = True,
+    ):
+        self.store = store
+        self.engine = QueryEngine(store)
+        self.health = HealthGate()
+        self.tracer = Tracer(trace_ratio)
+        self.export_path = export_path
+        self.expose_trace = expose_trace
+        self._engine_lock = threading.Lock()
+        # bounded LRU: shares are a convenience surface, not durable state
+        from collections import OrderedDict
+
+        self._shares: "OrderedDict[str, str]" = OrderedDict()
+        self._max_shares = 1024
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._bind = bind
+        self._port = port
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self._bind, self._port), handler)
+        self._port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dgraph-http", daemon=True
+        )
+        self._thread.start()
+        self.health.set_ok(True)
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @property
+    def addr(self) -> str:
+        return f"http://{self._bind}:{self._port}"
+
+    def stop(self) -> None:
+        self.health.set_ok(False)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if hasattr(self.store, "close"):
+            self.store.close()
+
+    # -- request execution -------------------------------------------------
+
+    def run_query(self, text: str, variables: Optional[dict] = None, debug: bool = False) -> dict:
+        """The ParseQueryAndMutation → ProcessWithMutation → encode path
+        with the reference's latency breakdown (query/query.go:102)."""
+        from dgraph_tpu import gql
+
+        NUM_QUERIES.add(1)
+        PENDING_QUERIES.add(1)
+        tr = self.tracer.begin()
+        lat = Latency()
+        try:
+            parsed = gql.parse(text, variables)
+            lat.record_parsing()
+            tr.printf("parsed: %d queries, mutation=%s", len(parsed.queries),
+                      parsed.mutation is not None)
+            out: dict = {}
+            from dgraph_tpu.query import outputnode
+
+            debug_token = outputnode.DEBUG_UIDS.set(debug)
+            try:
+                self._run_locked(parsed, out)
+            finally:
+                outputnode.DEBUG_UIDS.reset(debug_token)
+            lat.record_processing()
+            tr.printf("processed")
+            # json encode happens in the handler; pre-record here so the
+            # latency map is complete before attaching it
+            lat.record_json()
+            out["server_latency"] = lat.to_map()
+            return out
+        finally:
+            PENDING_QUERIES.add(-1)
+            self.tracer.finish(tr, "query", text[:120])
+
+    def _run_locked(self, parsed, out: dict) -> None:
+        from dgraph_tpu.serve.mutations import apply_mutation
+
+        with self._engine_lock:
+            uids = None
+            if parsed.mutation is not None:
+                uids = apply_mutation(self.store, parsed.mutation)
+            if parsed.schema_request is not None:
+                out["schema"] = self.engine._schema_response(parsed.schema_request)
+            if parsed.queries:
+                out.update(self.engine.execute(parsed))
+            elif parsed.mutation is not None and "schema" not in out:
+                out["code"] = "Success"
+                out["message"] = "Done"
+            if uids:
+                out["uids"] = {k[2:] if k.startswith("_:") else k: f"0x{v:x}"
+                               for k, v in uids.items()}
+
+
+def _make_handler(srv: DgraphServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "dgraph-tpu/0.1"
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _reply(self, code: int, body: bytes, ctype: str = "application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in _CORS.items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _err(self, code: int, msg: str):
+            self._reply(
+                code,
+                json.dumps({"code": "ErrorInvalidRequest", "message": msg}).encode(),
+            )
+
+        def do_OPTIONS(self):
+            self._reply(200, b"")
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            path = u.path
+            if path == "/health":
+                if srv.health.ok():
+                    self._reply(200, b"OK", "text/plain")
+                else:
+                    self._reply(503, b"\"uninitialized\"")
+            elif path == "/":
+                from dgraph_tpu.serve.dashboard import DASHBOARD_HTML
+
+                self._reply(200, DASHBOARD_HTML.encode(), "text/html")
+            elif path == "/debug/store":
+                with srv._engine_lock:
+                    stats = _store_stats(srv.store)
+                self._reply(200, json.dumps(stats).encode())
+            elif path == "/debug/prometheus_metrics":
+                self._reply(200, metrics.prometheus_text().encode(), "text/plain")
+            elif path == "/debug/requests":
+                if not srv.expose_trace:
+                    return self._err(403, "tracing not exposed")
+                self._reply(200, json.dumps(srv.tracer.recent()).encode())
+            elif path == "/admin/export":
+                try:
+                    with srv._engine_lock:
+                        info = export_rdf(srv.store, srv.export_path)
+                    self._reply(200, json.dumps(
+                        {"code": "Success", "message": "Export completed.", **info}
+                    ).encode())
+                except Exception as e:  # pragma: no cover
+                    self._err(500, str(e))
+            elif path == "/admin/shutdown":
+                self._reply(200, json.dumps(
+                    {"code": "Success", "message": "Server is shutting down"}
+                ).encode())
+                threading.Thread(target=srv.stop, daemon=True).start()
+            elif path.startswith("/share/"):
+                sid = path.rsplit("/", 1)[1]
+                q = srv._shares.get(sid)
+                if q is None:
+                    self._err(404, "no such share")
+                else:
+                    self._reply(200, json.dumps({"share": q}).encode())
+            else:
+                self._err(404, "no such endpoint")
+
+        def do_POST(self):
+            u = urlparse(self.path)
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n).decode("utf-8", "replace")
+            if u.path == "/query":
+                qs = parse_qs(u.query)
+                debug = qs.get("debug", ["false"])[0] == "true"
+                try:
+                    vars_hdr = self.headers.get("X-Dgraph-Vars")
+                    variables = json.loads(vars_hdr) if vars_hdr else None
+                    out = srv.run_query(body, variables, debug=debug)
+                    self._reply(200, json.dumps(out).encode())
+                except Exception as e:
+                    self._err(400, str(e))
+            elif u.path == "/share":
+                sid = hashlib.sha256(body.encode()).hexdigest()[:16]
+                srv._shares[sid] = body
+                srv._shares.move_to_end(sid)
+                while len(srv._shares) > srv._max_shares:
+                    srv._shares.popitem(last=False)
+                self._reply(200, json.dumps({"code": "Success", "uids": {"share": sid}}).encode())
+            else:
+                self._err(404, "no such endpoint")
+
+    return Handler
+
+
+def _store_stats(store: PostingStore) -> dict:
+    """/debug/store — the badger-stats analog (cmd/dgraph/main.go:448)."""
+    preds = {}
+    for p in store.predicates():
+        pd = store.peek(p)
+        if pd is None:
+            continue
+        preds[p] = {
+            "edges": sum(len(s) for s in pd.edges.values()),
+            "values": len(pd.values),
+        }
+    return {
+        "predicates": preds,
+        "uids": len(store.uids),
+        "max_uid": store.uids.max_uid,
+    }

@@ -1,0 +1,73 @@
+"""Server options: flags + optional YAML config file.
+
+Equivalent of dgraph/config.go:82-104 + x.LoadConfigFromYAML
+(cmd/dgraph/main.go:164-168): defaults, YAML merge, then explicit
+overrides win."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, fields, replace
+from typing import Optional
+
+
+@dataclass
+class Options:
+    # storage
+    postings_dir: str = "p"
+    wal_dir: str = "w"
+    export_path: str = "export"
+    sync_writes: bool = False
+    # serving
+    port: int = 8080
+    bind: str = "127.0.0.1"
+    # cluster identity (mirrors --idx/--groups/--peer)
+    raft_id: int = 1
+    group_ids: str = "0"
+    peer: str = ""
+    my_addr: str = ""
+    workers: int = 4
+    # observability
+    trace_ratio: float = 0.0
+    expose_trace: bool = False
+    # engine
+    num_pending: int = 1000
+    max_edges: int = 1_000_000
+
+    def merged_with_yaml(self, path: str) -> "Options":
+        """Overlay keys from a simple `key: value` YAML file onto self.
+        Callers wanting flags-beat-YAML precedence (the reference applies
+        YAML before flags) must merge BEFORE applying flag values — see
+        cli/server.py build_options."""
+        vals = _load_simple_yaml(path)
+        known = {f.name: f.type for f in fields(self)}
+        updates = {}
+        for k, v in vals.items():
+            k = k.replace("-", "_")
+            if k in known:
+                cur = getattr(self, k)
+                updates[k] = _coerce(v, type(cur))
+        return replace(self, **updates)
+
+
+def _coerce(v: str, t):
+    if t is bool:
+        return str(v).strip().lower() in ("1", "true", "yes", "on")
+    if t is int:
+        return int(v)
+    if t is float:
+        return float(v)
+    return str(v)
+
+
+def _load_simple_yaml(path: str) -> dict:
+    """Flat `key: value` YAML subset (the reference's config files are
+    flat, cmd/dgraph/testrun/conf1.yaml)."""
+    out = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line or ":" not in line:
+                continue
+            k, v = line.split(":", 1)
+            out[k.strip()] = v.strip().strip("'\"")
+    return out

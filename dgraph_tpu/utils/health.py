@@ -1,0 +1,22 @@
+"""Health gate: serve /health 503 until the engine is initialised.
+
+Equivalent of x/health.go:51 — the reference only answers OK after the
+raft nodes are up (worker/groups.go:174)."""
+
+from __future__ import annotations
+
+import threading
+
+
+class HealthGate:
+    def __init__(self):
+        self._ok = threading.Event()
+
+    def set_ok(self, ok: bool = True) -> None:
+        if ok:
+            self._ok.set()
+        else:
+            self._ok.clear()
+
+    def ok(self) -> bool:
+        return self._ok.is_set()

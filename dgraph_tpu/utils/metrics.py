@@ -1,0 +1,138 @@
+"""Metrics registry with Prometheus text exposition.
+
+Equivalent of x/metrics.go (expvar counters bridged to a Prometheus
+collector and served at /debug/prometheus_metrics).  The counter set
+mirrors the reference's: posting reads/writes, cache hit/miss, pending
+queries/proposals, per-predicate mutation counts (task.go PredicateStats).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class Counter:
+    """Monotonic counter (expvar.Int analog)."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    def value(self) -> int:
+        return self._v
+
+
+class Gauge:
+    """Settable gauge (expvar.Int used as a gauge in the reference)."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = v
+
+    def add(self, n: float = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    def value(self) -> float:
+        return self._v
+
+
+class LabeledCounter:
+    """Counter family keyed by one label (the per-predicate Map in
+    x/metrics.go / task.go:137 PredicateStats)."""
+
+    def __init__(self, name: str, label: str):
+        self.name = name
+        self.label = label
+        self._m: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def add(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._m[key] = self._m.get(key, 0) + n
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._m)
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._labeled: Dict[str, LabeledCounter] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def labeled(self, name: str, label: str = "predicate") -> LabeledCounter:
+        with self._lock:
+            l = self._labeled.get(name)
+            if l is None:
+                l = self._labeled[name] = LabeledCounter(name, label)
+            return l
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (the collector at
+        x/metrics.go:119 re-done natively)."""
+        lines = []
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            labeled = list(self._labeled.values())
+        for c in sorted(counters, key=lambda c: c.name):
+            lines.append(f"# TYPE {c.name} counter")
+            lines.append(f"{c.name} {c.value()}")
+        for g in sorted(gauges, key=lambda g: g.name):
+            lines.append(f"# TYPE {g.name} gauge")
+            lines.append(f"{g.name} {g.value()}")
+        for l in sorted(labeled, key=lambda l: l.name):
+            lines.append(f"# TYPE {l.name} counter")
+            for k, v in sorted(l.snapshot().items()):
+                esc = k.replace("\\", "\\\\").replace('"', '\\"')
+                lines.append(f'{l.name}{{{l.label}="{esc}"}} {v}')
+        return "\n".join(lines) + "\n"
+
+
+# Global registry with the reference's standard counter set pre-named
+# (x/metrics.go:27-58); components fetch these by name.
+metrics = MetricsRegistry()
+
+POSTING_READS = metrics.counter("dgraph_posting_reads_total")
+POSTING_WRITES = metrics.counter("dgraph_posting_writes_total")
+CACHE_HIT = metrics.counter("dgraph_cache_hits_total")
+CACHE_MISS = metrics.counter("dgraph_cache_miss_total")
+PENDING_QUERIES = metrics.gauge("dgraph_pending_queries")
+PENDING_PROPOSALS = metrics.gauge("dgraph_pending_proposals")
+NUM_QUERIES = metrics.counter("dgraph_num_queries_total")
+NUM_MUTATIONS = metrics.counter("dgraph_num_mutations_total")
+ARENA_BYTES = metrics.gauge("dgraph_arena_bytes")
+MAX_PL_LENGTH = metrics.gauge("dgraph_max_posting_list_length")
+PREDICATE_STATS = metrics.labeled("dgraph_predicate_mutations_total")

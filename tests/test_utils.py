@@ -1,0 +1,90 @@
+"""Tests for the shared infra (utils/ ≈ reference x/)."""
+
+import threading
+
+import pytest
+
+from dgraph_tpu.utils import Options, WaterMark
+from dgraph_tpu.utils.metrics import MetricsRegistry
+from dgraph_tpu.utils.trace import Latency, Tracer, _fmt_ns
+
+
+def test_watermark_contiguous():
+    wm = WaterMark()
+    for i in (1, 2, 3, 5):
+        wm.begin(i)
+    wm.done(1)
+    wm.done(2)
+    assert wm.done_until() == 2
+    wm.done(5)
+    assert wm.done_until() == 2  # 3 still pending blocks 5
+    wm.done(3)
+    assert wm.done_until() == 5
+
+
+def test_watermark_wait():
+    wm = WaterMark()
+    wm.begin(7)
+    t = threading.Thread(target=lambda: wm.done(7))
+    t.start()
+    assert wm.wait_for_mark(7, timeout=5)
+    t.join()
+
+
+def test_metrics_prometheus_text():
+    r = MetricsRegistry()
+    r.counter("reads_total").add(3)
+    r.gauge("pending").set(2)
+    r.labeled("per_pred_total").add("name", 5)
+    text = r.prometheus_text()
+    assert "reads_total 3" in text
+    assert "pending 2" in text
+    assert 'per_pred_total{predicate="name"} 5' in text
+    assert "# TYPE reads_total counter" in text
+
+
+def test_latency_map():
+    lat = Latency()
+    lat.record_parsing()
+    lat.record_processing()
+    lat.record_json()
+    m = lat.to_map()
+    assert "total" in m and "parsing" in m and "processing" in m
+
+
+def test_fmt_ns():
+    assert _fmt_ns(500) == "500ns"
+    assert _fmt_ns(79_300_000) == "79.3ms"
+    assert _fmt_ns(2_000_000_000) == "2s"
+
+
+def test_tracer_sampling():
+    t = Tracer(ratio=1.0)
+    tr = t.begin()
+    tr.printf("step %d", 1)
+    t.finish(tr, "query", "q1")
+    assert t.recent()[0]["events"][0]["msg"] == "step 1"
+    t0 = Tracer(ratio=0.0)
+    tr0 = t0.begin()
+    tr0.printf("never")
+    t0.finish(tr0, "query", "q2")
+    assert t0.recent() == []
+
+
+def test_options_yaml_merge(tmp_path):
+    cfg = tmp_path / "conf.yaml"
+    cfg.write_text("port: 9999\nsync_writes: true\n# comment\npostings_dir: /data/p\n")
+    opts = Options().merged_with_yaml(str(cfg))
+    assert opts.port == 9999
+    assert opts.sync_writes is True
+    assert opts.postings_dir == "/data/p"
+
+
+def test_flags_beat_yaml(tmp_path):
+    from dgraph_tpu.cli.server import build_options
+
+    cfg = tmp_path / "conf.yaml"
+    cfg.write_text("port: 8080\nexport_path: /from/yaml\n")
+    opts = build_options(["--config", str(cfg), "--port", "9000"])
+    assert opts.port == 9000          # explicit flag wins over YAML
+    assert opts.export_path == "/from/yaml"  # YAML beats the built-in default
