@@ -43,12 +43,23 @@ class QueryEngine:
     def run(self, text: str, variables: Optional[Dict[str, str]] = None) -> dict:
         """Parse and execute a request; returns the JSON-able response dict
         (the analog of ProcessWithMutation + ToFastJSON)."""
-        parsed = gql.parse(text, variables)
-        if parsed.mutation is not None:
-            from dgraph_tpu.serve.mutations import apply_mutation
+        return self.run_parsed(gql.parse(text, variables))
 
-            apply_mutation(self.store, parsed.mutation)
+    def run_parsed(self, parsed: "gql.ParsedResult") -> dict:
+        """Execute an already-parsed request — the single request pipeline
+        shared by the embedded path (run) and the HTTP server."""
         out: dict = {}
+        if parsed.mutation is not None:
+            from dgraph_tpu.serve.mutations import (
+                apply_mutation,
+                format_assigned_uids,
+            )
+
+            blanks = apply_mutation(self.store, parsed.mutation)
+            if blanks:
+                # assigned blank-node uids, as the reference's mutation
+                # response carries (protos AssignedUids)
+                out["uids"] = format_assigned_uids(blanks)
         if parsed.schema_request is not None:
             out["schema"] = self._schema_response(parsed.schema_request)
         if parsed.queries:
@@ -125,6 +136,13 @@ class QueryEngine:
 
     def _root_uids(self, sg: SubGraph, resolver: FuncResolver) -> np.ndarray:
         if sg.func is None:
+            # func-less block: legal when every child is an aggregation /
+            # math / val fetch (the reference's aggregation-only blocks,
+            # e.g. `total() { s as sum(val(c)) }`)
+            if sg.children and all(
+                c.attr in ("val", "math") or c.params.agg_func for c in sg.children
+            ):
+                return _EMPTY
             raise QueryError(f"block {sg.params.alias!r} needs func: or id:")
         return resolver.resolve(sg.func)
 

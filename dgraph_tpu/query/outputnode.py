@@ -225,6 +225,11 @@ def encode_block(store: PostingStore, sg: SubGraph) -> List[dict]:
     )
     if bare_count:
         out.append({"count": int(len(sg.dest_uids))})
+    if not len(sg.dest_uids) and sg.func is None:
+        # aggregation-only block (`total() { sum(val(c)) ... }`): values
+        # live under the synthetic uid 0
+        obj = encode_node(store, sg, 0)
+        return [obj] if obj else []
     for uid in sg.dest_uids.tolist():
         if sg.params.normalize:
             got = _normalize_flatten(store, sg, int(uid))

@@ -73,6 +73,15 @@ def nquad_to_edge(
                  facets=nq.facets or None, op=op)]
 
 
+def format_assigned_uids(blanks: Dict[str, int]) -> Dict[str, str]:
+    """Blank-node assignments → response 'uids' map: strip the '_:' prefix
+    and hex-format, as the reference's StripBlankNode does
+    (cmd/dgraph/main.go:432)."""
+    return {
+        (k[2:] if k.startswith("_:") else k): f"0x{v:x}" for k, v in blanks.items()
+    }
+
+
 def apply_mutation(store: PostingStore, mu: Mutation) -> Dict[str, int]:
     """Apply a mutation block; returns the blank-node → uid assignments
     (the reference returns these as 'uids' in the response)."""

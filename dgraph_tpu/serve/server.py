@@ -134,22 +134,8 @@ class DgraphServer:
             self.tracer.finish(tr, "query", text[:120])
 
     def _run_locked(self, parsed, out: dict) -> None:
-        from dgraph_tpu.serve.mutations import apply_mutation
-
         with self._engine_lock:
-            uids = None
-            if parsed.mutation is not None:
-                uids = apply_mutation(self.store, parsed.mutation)
-            if parsed.schema_request is not None:
-                out["schema"] = self.engine._schema_response(parsed.schema_request)
-            if parsed.queries:
-                out.update(self.engine.execute(parsed))
-            elif parsed.mutation is not None and "schema" not in out:
-                out["code"] = "Success"
-                out["message"] = "Done"
-            if uids:
-                out["uids"] = {k[2:] if k.startswith("_:") else k: f"0x{v:x}"
-                               for k, v in uids.items()}
+            out.update(self.engine.run_parsed(parsed))
 
 
 def _make_handler(srv: DgraphServer):

@@ -1,0 +1,257 @@
+"""Freebase-film-style e2e suite.
+
+Mirrors the reference's contrib/freebase golden tests (spielberg_test.go,
+simple_test.go) and the wiki performance-page queries (the 3-hop
+"co-director" and 4-level "Spielberg detail" shapes,
+wiki/content/performance/index.md:32,86): a film graph of directors,
+films, genres and performances, queried through the full
+parse → execute → JSON path.
+"""
+
+import pytest
+
+from dgraph_tpu.models import PostingStore
+from dgraph_tpu.query import QueryEngine
+
+
+SCHEMA = """
+    name: string @index(term, exact, fulltext) .
+    initial_release_date: datetime @index(year) .
+    director.film: uid @reverse @count .
+    genre: uid @reverse .
+    starring: uid .
+    performance.actor: uid @reverse .
+    performance.film: uid @reverse .
+"""
+
+RDF = """
+    _:spielberg <name> "Steven Spielberg" .
+    _:lucas <name> "George Lucas" .
+    _:hanks <name> "Tom Hanks" .
+    _:dicaprio <name> "Leonardo DiCaprio" .
+    _:hamill <name> "Mark Hamill" .
+
+    _:jaws <name> "Jaws" .
+    _:jaws <initial_release_date> "1975-06-20" .
+    _:et <name> "E.T. the Extra-Terrestrial" .
+    _:et <initial_release_date> "1982-06-11" .
+    _:catchme <name> "Catch Me If You Can" .
+    _:catchme <initial_release_date> "2002-12-25" .
+    _:terminal <name> "The Terminal" .
+    _:terminal <initial_release_date> "2004-06-18" .
+    _:starwars <name> "Star Wars" .
+    _:starwars <initial_release_date> "1977-05-25" .
+
+    _:spielberg <director.film> _:jaws .
+    _:spielberg <director.film> _:et .
+    _:spielberg <director.film> _:catchme .
+    _:spielberg <director.film> _:terminal .
+    _:lucas <director.film> _:starwars .
+
+    _:thriller <name> "Thriller" .
+    _:scifi <name> "Science Fiction" .
+    _:drama <name> "Drama" .
+    _:jaws <genre> _:thriller .
+    _:et <genre> _:scifi .
+    _:starwars <genre> _:scifi .
+    _:catchme <genre> _:drama .
+    _:terminal <genre> _:drama .
+
+    _:p1 <performance.actor> _:hanks .
+    _:catchme <starring> _:p1 .
+    _:p2 <performance.actor> _:hanks .
+    _:terminal <starring> _:p2 .
+    _:p3 <performance.actor> _:dicaprio .
+    _:catchme <starring> _:p3 .
+    _:p4 <performance.actor> _:hamill .
+    _:starwars <starring> _:p4 .
+"""
+
+
+@pytest.fixture(scope="module")
+def eng():
+    st = PostingStore()
+    e = QueryEngine(st)
+    e.run("mutation { schema { %s } set { %s } }" % (SCHEMA, RDF))
+    return e
+
+
+def test_spielberg_films_ordered(eng):
+    got = eng.run("""
+    {
+      dir(func: eq(name, "Steven Spielberg")) {
+        name
+        director.film (orderasc: initial_release_date) {
+          name
+          initial_release_date
+        }
+      }
+    }""")
+    films = got["dir"][0]["director.film"]
+    assert [f["name"] for f in films] == [
+        "Jaws",
+        "E.T. the Extra-Terrestrial",
+        "Catch Me If You Can",
+        "The Terminal",
+    ]
+    assert films[0]["initial_release_date"].startswith("1975-06-20")
+
+
+def test_four_level_detail(eng):
+    """The wiki perf page's 4-level Spielberg shape."""
+    got = eng.run("""
+    {
+      dir(func: eq(name, "Steven Spielberg")) {
+        name
+        director.film {
+          name
+          genre { name }
+          starring { performance.actor { name } }
+        }
+      }
+    }""")
+    films = {f["name"]: f for f in got["dir"][0]["director.film"]}
+    assert films["Jaws"]["genre"] == [{"name": "Thriller"}]
+    actors = {
+        a["performance.actor"][0]["name"]
+        for a in films["Catch Me If You Can"]["starring"]
+    }
+    assert actors == {"Tom Hanks", "Leonardo DiCaprio"}
+
+
+def test_three_hop_co_actor(eng):
+    """Hanks → performances → films → co-stars (the co-director 3-hop shape)."""
+    got = eng.run("""
+    {
+      me(func: eq(name, "Tom Hanks")) {
+        ~performance.actor {
+          ~starring {
+            name
+            starring { performance.actor { name } }
+          }
+        }
+      }
+    }""")
+    films = []
+    for perf in got["me"][0]["~performance.actor"]:
+        films.extend(perf["~starring"])
+    names = {f["name"] for f in films}
+    assert names == {"Catch Me If You Can", "The Terminal"}
+    costars = set()
+    for f in films:
+        for s in f.get("starring", []):
+            for a in s.get("performance.actor", []):
+                costars.add(a["name"])
+    assert costars == {"Tom Hanks", "Leonardo DiCaprio"}
+
+
+def test_var_block_chain(eng):
+    got = eng.run("""
+    {
+      var(func: eq(name, "Steven Spielberg")) {
+        fs as director.film
+      }
+      films(func: uid(fs), orderdesc: initial_release_date, first: 2) {
+        name
+      }
+    }""")
+    assert [f["name"] for f in got["films"]] == ["The Terminal", "Catch Me If You Can"]
+
+
+def test_value_var_and_math(eng):
+    got = eng.run("""
+    {
+      var(func: eq(name, "Steven Spielberg")) {
+        director.film { c as count(genre) }
+      }
+      total() {
+        s as sum(val(c))
+        doubled: math(s * 2)
+      }
+    }""")
+    assert got["total"][0]["sum(val(c))"] == 4.0
+    assert got["total"][0]["doubled"] == 8.0
+
+
+def test_genre_groupby(eng):
+    got = eng.run("""
+    {
+      dir(func: eq(name, "Steven Spielberg")) {
+        director.film @groupby(genre) {
+          count(uid)
+        }
+      }
+    }""")
+    groups = got["dir"][0]["director.film"][0]["@groupby"]
+    counts = sorted(g["count"] for g in groups)
+    assert counts == [1, 1, 2]
+
+
+def test_filter_year_and_fulltext(eng):
+    got = eng.run("""
+    {
+      films(func: anyofterms(name, "Jaws Terminal Star")) @filter(ge(initial_release_date, "1977-01-01")) {
+        name
+      }
+    }""")
+    names = {f["name"] for f in got["films"]}
+    assert names == {"The Terminal", "Star Wars"}
+
+
+def test_normalize(eng):
+    got = eng.run("""
+    {
+      dir(func: eq(name, "George Lucas")) @normalize {
+        d: name
+        director.film { f: name genre { g: name } }
+      }
+    }""")
+    assert got["dir"] == [{"d": "George Lucas", "f": "Star Wars", "g": "Science Fiction"}]
+
+
+def test_cascade(eng):
+    # only films that HAVE a genre edge survive @cascade at that level
+    got = eng.run("""
+    {
+      dir(func: eq(name, "Steven Spielberg")) @cascade {
+        name
+        director.film @filter(anyofterms(name, "Jaws")) { name genre { name } }
+      }
+    }""")
+    assert got["dir"][0]["director.film"] == [
+        {"name": "Jaws", "genre": [{"name": "Thriller"}]}
+    ]
+
+
+def test_count_at_root(eng):
+    got = eng.run("""
+    { f(func: ge(count(director.film), 4)) { name } }""")
+    assert got["f"] == [{"name": "Steven Spielberg"}]
+
+
+def test_shortest_path_film_graph(eng):
+    """Hanks —performance—film—performance— DiCaprio."""
+    uids = {}
+    for who in ("Tom Hanks", "Leonardo DiCaprio"):
+        r = eng.run('{ q(func: eq(name, "%s")) { _uid_ } }' % who)
+        uids[who] = r["q"][0]["_uid_"]
+    got = eng.run("""
+    {
+      shortest(from: %s, to: %s) {
+        ~performance.actor
+        ~starring
+        starring
+        performance.actor
+      }
+    }""" % (uids["Tom Hanks"], uids["Leonardo DiCaprio"]))
+    assert "_path_" in got
+    # path: hanks → p1|p2 → catchme → p3 → dicaprio (4 hops)
+    hops = 0
+    node = got["_path_"][0]
+    while True:
+        nxt = [v for k, v in node.items() if isinstance(v, list) and k != "uid"]
+        if not nxt:
+            break
+        node = nxt[0][0]
+        hops += 1
+    assert hops == 4
