@@ -28,12 +28,16 @@ class RoundRobinTransport(Transport):
     """Spread requests over several servers (loader main.go:222)."""
 
     def __init__(self, addrs):
+        import itertools
+        import threading
+
         self._ts = [HttpTransport(a) for a in addrs]
-        self._i = 0
+        self._next = itertools.cycle(self._ts)
+        self._lock = threading.Lock()
 
     def run(self, text, variables=None):
-        t = self._ts[self._i % len(self._ts)]
-        self._i += 1
+        with self._lock:
+            t = next(self._next)
         return t.run(text, variables)
 
 
@@ -52,32 +56,46 @@ def load_file(
     path: str,
     marks: SyncMarks | None = None,
     batch: int = 1000,
+    window: int = 4,
     progress_every: float = 2.0,
 ) -> int:
     """Stream one RDF file through the client; returns quads submitted.
 
     Checkpointing: quads accumulate into line-delimited chunks; each
-    chunk's last line number is begun before submit and marked done
-    after flush, so `done_until` resumes mid-file after a crash."""
+    chunk's last line number is begun before submit and marked done only
+    after a flush that covers it.  Up to ``window`` chunks are enqueued
+    between flushes so the client's ``pending`` workers actually overlap
+    submissions (one flush per window, not per chunk)."""
     skip_through = marks.done_until(path) if marks else 0
     pending: list = []
+    in_flight: list = []
     chunk_end = 0
     n = 0
     t0 = time.time()
     last_report = t0
 
+    def drain():
+        nonlocal in_flight
+        if not in_flight and not pending:
+            return
+        client.flush()
+        if marks:
+            for ce in in_flight:
+                marks.done(path, ce)
+        in_flight = []
+
     def submit_chunk():
-        nonlocal pending, chunk_end
+        nonlocal pending
         if not pending:
             return
         if marks:
             marks.begin(path, chunk_end)
         for q in pending:
             client.batch_set(q)
-        client.flush()
-        if marks:
-            marks.done(path, chunk_end)
+        in_flight.append(chunk_end)
         pending = []
+        if len(in_flight) >= max(1, window):
+            drain()
 
     for line_no, line in open_lines(path):
         if line_no <= skip_through:
@@ -93,6 +111,7 @@ def load_file(
                 print(f"  {path}: {n} quads, {rate:,.0f}/s", file=sys.stderr)
                 last_report = now
     submit_chunk()
+    drain()
     return n
 
 
@@ -124,7 +143,7 @@ def main(argv=None) -> int:
 
     total, t0 = 0, time.time()
     for path in ns.rdf:
-        total += load_file(client, path, marks, batch=ns.batch)
+        total += load_file(client, path, marks, batch=ns.batch, window=ns.concurrent)
     client.close()
     dt = time.time() - t0
     print(f"loaded {total} quads in {dt:.1f}s ({total / max(dt, 1e-9):,.0f}/s)")

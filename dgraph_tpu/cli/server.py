@@ -35,16 +35,23 @@ def build_options(argv=None) -> Options:
     p.add_argument("--export", dest="export_path", default=d.export_path)
     p.add_argument("--port", type=int, default=d.port)
     p.add_argument("--bind", default=d.bind)
-    p.add_argument("--sync", dest="sync_writes", action="store_true")
+    p.add_argument("--sync", dest="sync_writes", action="store_true",
+                   default=d.sync_writes)
     p.add_argument("--idx", dest="raft_id", type=int, default=d.raft_id)
     p.add_argument("--groups", dest="group_ids", default=d.group_ids)
     p.add_argument("--peer", default=d.peer)
     p.add_argument("--my", dest="my_addr", default=d.my_addr)
     p.add_argument("--trace", dest="trace_ratio", type=float, default=d.trace_ratio)
-    p.add_argument("--expose_trace", action="store_true")
+    p.add_argument("--expose_trace", action="store_true", default=d.expose_trace)
+    p.add_argument("--workers", type=int, default=d.workers)
+    p.add_argument("--num_pending", type=int, default=d.num_pending)
+    p.add_argument("--max_edges", type=int, default=d.max_edges)
     p.add_argument("--config", default="", help="YAML config file (flat key: value)")
     ns = p.parse_args(argv)
-    return Options(**{k: getattr(ns, k) for k in vars(ns) if k != "config"})
+    # start from the YAML-merged defaults so Options fields without a flag
+    # survive (previously YAML-only keys like workers were dropped)
+    merged = {**d.__dict__, **{k: getattr(ns, k) for k in vars(ns) if k != "config"}}
+    return Options(**merged)
 
 
 def main(argv=None) -> int:
@@ -73,7 +80,11 @@ def main(argv=None) -> int:
         while srv._thread is not None and srv._thread.is_alive():
             srv._thread.join(timeout=0.5)
     except KeyboardInterrupt:
-        srv.stop()
+        pass
+    # stop() is idempotent and holds its lock through teardown, so this
+    # blocks until the store is durably closed even when shutdown was
+    # initiated by /admin/shutdown on a daemon thread
+    srv.stop()
     return 0
 
 

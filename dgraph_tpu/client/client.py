@@ -114,6 +114,8 @@ class DgraphClient:
         self._set_q: "queue.Queue[Optional[str]]" = queue.Queue(maxsize=opts.size * opts.pending)
         self._del_q: "queue.Queue[Optional[str]]" = queue.Queue(maxsize=opts.size * opts.pending)
         self._err: Optional[BaseException] = None
+        self._last_op: Optional[str] = None
+        self._prod_lock = threading.Lock()
         self._mutations = 0
         self._lock = threading.Lock()
         self._workers: List[threading.Thread] = []
@@ -130,11 +132,30 @@ class DgraphClient:
 
     def batch_set(self, e) -> None:
         self._check_err()
-        self._set_q.put(e.nquad() if isinstance(e, Edge) else str(e))
+        with self._prod_lock:
+            self._op_barrier("set")
+            self._set_q.put(e.nquad() if isinstance(e, Edge) else str(e))
 
     def batch_delete(self, e) -> None:
         self._check_err()
-        self._del_q.put(e.nquad() if isinstance(e, Edge) else str(e))
+        with self._prod_lock:
+            self._op_barrier("del")
+            self._del_q.put(e.nquad() if isinstance(e, Edge) else str(e))
+
+    def _op_barrier(self, op: str) -> None:
+        """Sets and deletes travel in separate queues drained concurrently;
+        without a barrier a delete enqueued after a set of the same quad
+        could reach the server first.  On an op-type flip, drain what's
+        queued so cross-op order is preserved.  Caller holds _prod_lock so
+        the flip check and the enqueue are atomic across producer threads
+        (alternating ops serialize — bulk loads are single-op, so the
+        common path never blocks here)."""
+        if self._last_op != op:
+            if self._last_op is not None:
+                self._set_q.join()
+                self._del_q.join()
+                self._check_err()
+            self._last_op = op
 
     def add_schema(self, schema: str) -> None:
         self.transport.run("mutation { schema {\n" + schema + "\n} }")

@@ -632,11 +632,24 @@ class RaftNode:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
             entry = self.storage.entry_at(self.last_applied)
+            apply_err: Optional[Exception] = None
             if entry is not None and entry.data:
-                self.apply_fn(entry.index, entry.data)
+                try:
+                    self.apply_fn(entry.index, entry.data)
+                except Exception as e:  # noqa: BLE001 — a bad entry must not
+                    # wedge the group: report to the proposer and keep
+                    # advancing, as the reference resolves the proposal
+                    # with the apply error (draft.go process→props.Done)
+                    import traceback
+
+                    traceback.print_exc()
+                    apply_err = e
             fut = self._pending.pop(self.last_applied, None)
             if fut is not None and not fut.done():
-                fut.set_result(self.last_applied)
+                if apply_err is not None:
+                    fut.set_exception(apply_err)
+                else:
+                    fut.set_result(self.last_applied)
         self._maybe_snapshot()
 
     def _maybe_snapshot(self) -> None:

@@ -80,7 +80,13 @@ function hist(q){
 }
 function renderHist(){
   const h = JSON.parse(localStorage.getItem('dgh')||'[]');
-  $('hist').innerHTML = h.map((q,i)=>`<div onclick='loadHist(${i})'>${q.replace(/\s+/g,' ').slice(0,90)}</div>`).join('');
+  const el = $('hist'); el.innerHTML = '';
+  h.forEach((q,i)=>{
+    const d = document.createElement('div');
+    d.textContent = q.replace(/\s+/g,' ').slice(0,90);  // textContent: query text must never execute
+    d.onclick = ()=>loadHist(i);
+    el.appendChild(d);
+  });
 }
 function loadHist(i){ $('q').value = JSON.parse(localStorage.getItem('dgh')||'[]')[i]; }
 async function share(){

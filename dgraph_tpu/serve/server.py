@@ -59,6 +59,8 @@ class DgraphServer:
         self.export_path = export_path
         self.expose_trace = expose_trace
         self._engine_lock = threading.Lock()
+        self._stop_lock = threading.Lock()
+        self._stopped = False
         # bounded LRU: shares are a convenience surface, not durable state
         from collections import OrderedDict
 
@@ -90,13 +92,23 @@ class DgraphServer:
         return f"http://{self._bind}:{self._port}"
 
     def stop(self) -> None:
-        self.health.set_ok(False)
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-        if hasattr(self.store, "close"):
-            self.store.close()
+        # idempotent (admin endpoint + signal handler can both call it) and
+        # serialized against in-flight mutations: the store is only closed
+        # under the engine lock, after the listener stops accepting.  The
+        # stop lock is held for the WHOLE teardown so a second caller
+        # returning means teardown (incl. the WAL flush) has completed.
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self.health.set_ok(False)
+            if self._httpd is not None:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+                self._httpd = None
+            with self._engine_lock:
+                if hasattr(self.store, "close"):
+                    self.store.close()
+            self._stopped = True
 
     # -- request execution -------------------------------------------------
 

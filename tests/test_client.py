@@ -134,3 +134,28 @@ def test_checkpoint_partial_resume(tmp_path):
     # new process: only the contiguous prefix survives
     marks2 = SyncMarks(str(tmp_path))
     assert marks2.done_until("f.rdf") == 100
+
+
+def test_set_then_delete_ordering(srv):
+    """A delete enqueued after a set of the same quad must win even with
+    multiple pending workers (cross-op barrier)."""
+    c = DgraphClient(EmbeddedTransport(srv), BatchMutationOptions(size=4, pending=3))
+    e = ClientEdge.value("0x200", "tag", "x")
+    for _ in range(8):
+        c.batch_set(e)
+        c.batch_delete(e)
+    c.flush()
+    out = c.query("{ q(func: uid(0x200)) { tag } }")
+    assert out.get("q", []) == []
+    # and delete-then-set leaves it present
+    c.batch_delete(e)
+    c.batch_set(e)
+    c.flush()
+    out = c.query("{ q(func: uid(0x200)) { tag } }")
+    assert out["q"] == [{"tag": "x"}]
+    c.close()
+
+
+def test_server_stop_idempotent(srv):
+    srv.stop()
+    srv.stop()  # second call must be a no-op, not a double-close

@@ -205,3 +205,34 @@ def test_raft_snapshot_catchup(tmp_path):
         for g in groups:
             g.stop()
         g3.stop()
+
+
+def test_raft_apply_error_resolves_future_and_continues(tmp_path):
+    """An apply_fn exception must fail that proposal's future (not leave it
+    pending forever) and must not stop later entries from applying."""
+    from dgraph_tpu.cluster.raft import RaftNode, RaftStorage
+
+    applied = []
+
+    def apply_fn(idx, data):
+        if data == b"boom":
+            raise ValueError("bad entry")
+        applied.append(data)
+
+    tr = InMemoryTransport()
+    node = RaftNode(
+        node_id="solo", group=1, peers=["solo"],
+        storage=RaftStorage(str(tmp_path / "solo")),
+        transport=tr, apply_fn=apply_fn,
+    )
+    tr.register(node)
+    node.start()
+    try:
+        assert wait_for(lambda: node.is_leader)
+        assert node.propose_and_wait(b"ok1", timeout=5) > 0
+        with pytest.raises(ValueError):
+            node.propose_and_wait(b"boom", timeout=5)
+        assert node.propose_and_wait(b"ok2", timeout=5) > 0
+        assert applied == [b"ok1", b"ok2"]
+    finally:
+        node.stop()

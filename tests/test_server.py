@@ -131,3 +131,18 @@ def test_debug_attaches_uids(srv):
     assert out["q"][0]["_uid_"] == "0x1"
     out2 = _post(srv.addr, "/query", '{ q(func: anyofterms(name, "Alice")) { name } }')
     assert "_uid_" not in out2["q"][0]
+
+
+def test_yaml_config_values_survive(tmp_path):
+    """YAML-only values (sync_writes, workers) must not be silently dropped
+    by flag parsing; explicit flags still win."""
+    from dgraph_tpu.cli.server import build_options
+
+    cfg = tmp_path / "conf.yaml"
+    cfg.write_text("sync_writes: true\nworkers: 9\nport: 7001\n")
+    opts = build_options(["--config", str(cfg)])
+    assert opts.sync_writes is True
+    assert opts.workers == 9
+    assert opts.port == 7001
+    opts = build_options(["--config", str(cfg), "--port", "7002"])
+    assert opts.port == 7002 and opts.sync_writes is True
