@@ -82,32 +82,33 @@ def main():
         t1 = int(a.degree_of_rows(rows).sum())
         f1 = np.unique(np_expand(a.h_offsets, h_dst, rows))
         t2 = int(a.degree_of_rows(f1).sum())
-        return t1, len(f1), t2
+        return t1, t2
 
-    worst1 = worstf1 = worst2 = 1
+    worst1 = worst2 = 1
     for f in frontiers:
-        t1, nf1, t2 = caps_for(f)
+        t1, t2 = caps_for(f)
         worst1 = max(worst1, t1)
-        worstf1 = max(worstf1, nf1)
         worst2 = max(worst2, t2)
-    cap1, capf1, cap2 = ops.bucket(worst1), ops.bucket(worstf1), ops.bucket(worst2)
+    cap1, cap2 = ops.bucket(worst1), ops.bucket(worst2)
     fcap = ops.bucket(max(len(f) for f in frontiers))
 
-    # ONE device dispatch for the whole query batch: per-query work is a
-    # pure gather/scatter pipeline (dense rows, mask-based dedup — no
-    # sorts, no searchsorted), and the per-call relay latency of this
-    # environment (~60ms) is amortized across all queries.
-    def one_query(_, frontier):
+    # ONE device dispatch for the whole query batch.  The per-query
+    # pipeline is scatter-free (TPU scatters serialize): CSR expansion
+    # computes slot owners by binary search / prefix sum, and frontier
+    # dedup is one sort + neighbor-compare that leaves dups as skip rows
+    # (no universe-sized presence mask, no compaction).  The final result
+    # set is compacted once, outside the per-query loop.
+    def one_query(carry, frontier):
         out1, _s1, t1 = ops.expand_csr(a.offsets, a.dst, ops.frontier_rows(frontier), cap1)
-        f1 = ops.unique_dense(out1, n_nodes, capf1)
-        out2, _s2, t2 = ops.expand_csr(a.offsets, a.dst, ops.frontier_rows(f1), cap2)
-        f2 = ops.unique_dense(out2, n_nodes, cap2)
-        return None, (t1 + t2, f2)
+        rows1 = ops.unique_rows_sorted(out1)
+        out2, _s2, t2 = ops.expand_csr(a.offsets, a.dst, rows1, cap2)
+        return out2, t1 + t2
 
     @jax.jit
     def run_batch(frontiers_mat):
-        _, (counts, f2s) = jax.lax.scan(one_query, None, frontiers_mat)
-        return counts, f2s[-1]
+        init = jnp.full((cap2,), SENT, dtype=jnp.int32)
+        last, counts = jax.lax.scan(one_query, init, frontiers_mat)
+        return counts, ops.sort_unique(last)
 
     fmat = jnp.asarray(np.stack([ops.pad_to(f, fcap) for f in frontiers]))
 

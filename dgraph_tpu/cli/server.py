@@ -71,6 +71,10 @@ def main(argv=None) -> int:
     stop = {"requested": False}
 
     def on_signal(signum, frame):
+        # disarm: a second Ctrl+C must not re-enter stop() on the same
+        # thread while the first holds the (non-reentrant) stop lock
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
         stop["requested"] = True
         srv.stop()
 

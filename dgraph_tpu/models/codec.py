@@ -32,6 +32,7 @@ SCHEMA = 0x02
 XID = 0x03
 LEASE = 0x04
 DELPRED = 0x05
+BULKEDGES = 0x06
 
 _F_DEL = 1
 _F_VALUE = 2
@@ -203,6 +204,32 @@ def decode_edge(b: bytes):
         facets=facets,
         op="del" if flags & _F_DEL else "set",
     )
+
+
+def encode_bulk_edges(pred: str, src, dst) -> bytes:
+    """One record for a whole group of plain uid edges (the native bulk
+    ingest journals per predicate-group, not per edge)."""
+    import numpy as np
+
+    buf = bytearray([BULKEDGES])
+    put_str(buf, pred)
+    src = np.ascontiguousarray(src, dtype="<i8")
+    dst = np.ascontiguousarray(dst, dtype="<i8")
+    put_uvarint(buf, len(src))
+    buf += src.tobytes()
+    buf += dst.tobytes()
+    return bytes(buf)
+
+
+def decode_bulk_edges(b: bytes):
+    import numpy as np
+
+    assert b[0] == BULKEDGES
+    pred, pos = get_str(b, 1)
+    n, pos = uvarint(b, pos)
+    src = np.frombuffer(b, dtype="<i8", count=n, offset=pos)
+    dst = np.frombuffer(b, dtype="<i8", count=n, offset=pos + 8 * n)
+    return pred, src, dst
 
 
 def encode_schema(text: str) -> bytes:

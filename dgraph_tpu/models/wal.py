@@ -123,6 +123,9 @@ def apply_record(store: PostingStore, payload: bytes) -> None:
     elif tag == codec.LEASE:
         nxt, _ = codec.uvarint(payload, 1)
         store.uids.reserve_through(nxt - 1)
+    elif tag == codec.BULKEDGES:
+        pred, src, dst = codec.decode_bulk_edges(payload)
+        PostingStore.bulk_set_uid_edges(store, pred, src, dst)
     elif tag == codec.DELPRED:
         pred, _ = codec.get_str(payload, 1)
         PostingStore.delete_predicate(store, pred)
@@ -270,6 +273,14 @@ class DurableStore(PostingStore):
         if flush and not self._replaying:
             self.wal.flush()
         return n
+
+    def bulk_set_uid_edges(self, pred: str, src, dst) -> None:
+        # one WAL record for the whole predicate group
+        self._journal(codec.encode_bulk_edges(pred, src, dst))
+        super().bulk_set_uid_edges(pred, src, dst)
+        self.applied_index += 1
+        if not self._replaying and not self._in_batch:
+            self.wal.flush()
 
     def apply_schema(self, text: str) -> None:
         parse_schema(text, into=self.schema)  # validate before journaling

@@ -26,6 +26,7 @@ from dgraph_tpu.ops.sets import (  # noqa: F401
     rows_of,
     range_rows,
     unique_dense,
+    unique_rows_sorted,
     frontier_rows,
 )
 from dgraph_tpu.ops import ref  # noqa: F401

@@ -234,6 +234,21 @@ def unique_dense(x: jnp.ndarray, n_universe: int, cap: int) -> jnp.ndarray:
 
 
 @jax.jit
+def unique_rows_sorted(x: jnp.ndarray) -> jnp.ndarray:
+    """Deduplicate a padded uid vector into *dense-arena row* form without
+    compaction: sort, then mark duplicates and padding as -1 (expand_csr's
+    skip marker).  One sort + one compare — no universe-sized scatter, no
+    nonzero compaction; the price is that the result keeps the input's
+    capacity (harmless: skip rows cost nothing in the expansion kernel).
+    This is the frontier-dedup that replaces unique_dense on the 2-hop
+    hot path (TPU scatters serialize; sorts ride the VPU)."""
+    x = jnp.sort(x)
+    first = jnp.concatenate([jnp.ones((1,), dtype=bool), x[1:] != x[:-1]])
+    keep = first & (x != SENT)
+    return jnp.where(keep, x, -1).astype(jnp.int32)
+
+
+@jax.jit
 def frontier_rows(f: jnp.ndarray) -> jnp.ndarray:
     """Frontier uids → row indices for a *dense* arena (row i == uid i):
     just map padding to the skip marker."""

@@ -85,6 +85,32 @@ def _facet_value(raw: str) -> TypedValue:
     return TypedValue(TypeID.STRING, raw)
 
 
+def parse_facets_body(body: str, context: str = "") -> Dict[str, TypedValue]:
+    """Parse the inside of a facet list "(k=v, k2=v2)" — shared by the
+    regex parser and the native scanner's Python side."""
+    out: Dict[str, TypedValue] = {}
+    pos = 0
+    for fm in _FACET_PAIR_RE.finditer(body):
+        if body[pos : fm.start()].strip(" ,\t"):
+            raise ParseError(f"bad facet near {body[pos:fm.start()]!r} in {context!r}")
+        out[fm.group(1)] = _facet_value(fm.group(2))
+        pos = fm.end()
+    if body[pos:].strip(" ,\t"):
+        raise ParseError(f"bad facet near {body[pos:]!r} in {context!r}")
+    return out
+
+
+def typed_literal(body: str, tname: str) -> TypedValue:
+    """Literal body + optional ^^<type> name → TypedValue (rdf/parse.go's
+    typed-object handling)."""
+    if tname:
+        tid = type_from_name(tname)
+        from dgraph_tpu.models.types import convert
+
+        return convert(TypedValue(TypeID.STRING, body), tid)
+    return TypedValue(TypeID.DEFAULT, body)
+
+
 def parse_line(line: str) -> Optional[NQuad]:
     """Parse one N-Quad; returns None for blank/comment lines."""
     s = line.strip()
@@ -120,28 +146,11 @@ def _quad_from_match(m, line: str) -> NQuad:
         if tm is None:
             raise ParseError(f"bad literal in N-Quad: {line!r}")
         body = _unescape(tm.group(1)[1:-1])
-        lang = tm.group(2) or ""
-        tname = tm.group(3) or ""
-        if tname:
-            tid = type_from_name(tname)
-            from dgraph_tpu.models.types import convert
-
-            nq.object_value = convert(TypedValue(TypeID.STRING, body), tid)
-        else:
-            nq.object_value = TypedValue(TypeID.DEFAULT, body)
-        nq.lang = lang
+        nq.object_value = typed_literal(body, tm.group(3) or "")
+        nq.lang = tm.group(2) or ""
 
     if m.group("facets"):
-        body = m.group("facets")
-        # split on commas outside quoted values ("met in Paris, 2019")
-        pos = 0
-        for fm in _FACET_PAIR_RE.finditer(body):
-            if body[pos : fm.start()].strip(" ,\t"):
-                raise ParseError(f"bad facet near {body[pos:fm.start()]!r} in {line!r}")
-            nq.facets[fm.group(1)] = _facet_value(fm.group(2))
-            pos = fm.end()
-        if body[pos:].strip(" ,\t"):
-            raise ParseError(f"bad facet near {body[pos:]!r} in {line!r}")
+        nq.facets = parse_facets_body(m.group("facets"), line)
     return nq
 
 

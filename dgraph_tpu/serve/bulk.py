@@ -1,0 +1,145 @@
+"""Native-accelerated bulk ingest.
+
+The set-mutation hot path: scan N-Quads with the C++ tokenizer
+(native/nquad_scan.cpp), resolve each distinct subject/object/predicate
+string exactly once, then apply plain uid edges in vectorized
+per-predicate groups (store.bulk_set_uid_edges — one WAL record per
+group) and values/complex quads through the ordinary edge path.
+
+Falls back transparently (return None) when the native scanner is
+unavailable or the input trips a grammar corner the scanner rejects —
+the caller then uses the pure-Python parser so error surfaces are
+identical.  The reference's equivalent throughput lever is the loader's
+pipelined goroutines + badger batch writes (cmd/dgraphloader/main.go:151,
+posting/lists.go gentle commit); ours is native scanning + grouped
+application.
+
+Ordering note: within one set block, plain uid edges apply grouped by
+predicate before value edges and faceted/complex quads.  Set operations
+commute except for repeated writes of the same (pred, src, lang) value
+or the same facet edge, whose relative order IS preserved (values and
+complex quads each apply in input order).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from dgraph_tpu.models.password import hash_password
+from dgraph_tpu.models.store import Edge, PostingStore
+from dgraph_tpu.models.types import TypeID, TypedValue, convert
+from dgraph_tpu.rdf.parse import _unescape, parse_facets_body, typed_literal
+
+
+def fast_apply_set(
+    store: PostingStore, text: str, blanks: Dict[str, int]
+) -> Optional[int]:
+    """Apply a set-mutation body via the native scanner.  Returns the
+    number of quads applied, or None to request the Python fallback."""
+    try:
+        from dgraph_tpu import native
+    except Exception:  # pragma: no cover - import failure == no native
+        return None
+    try:
+        r = native.scan(text)
+    except ValueError:
+        return None  # let the Python parser produce its ParseError
+    if r is None:
+        return None
+    if r.n == 0:
+        return 0
+    from dgraph_tpu.native import (
+        F_HAS_FACETS,
+        F_HAS_LABEL,
+        F_HAS_LANG,
+        F_HAS_TYPE,
+        F_LIT_ESCAPED,
+        F_OBJ_LITERAL,
+        F_OBJ_STAR,
+        F_PRED_STAR,
+        F_SUBJ_STAR,
+    )
+
+    buf = r.buf
+    flags = r.flags.astype(np.int32)
+
+    # '*' anywhere is delete-only syntax; stars in a set block are an
+    # error — let the Python path raise it
+    if np.any(flags & (F_SUBJ_STAR | F_PRED_STAR | F_OBJ_STAR)):
+        return None
+
+    # -- resolve unique tables ---------------------------------------------
+    from dgraph_tpu.serve.mutations import resolve_uid
+
+    subj_uid = r.subj_uid.copy()
+    obj_uid = r.obj_uid.copy()
+    # reserve the explicit uid range FIRST: fresh blank-node uids must not
+    # collide with uids named later in the same block
+    explicit_max = int(subj_uid.max()) if len(subj_uid) else 0
+    if len(obj_uid):
+        explicit_max = max(explicit_max, int(obj_uid.max()))
+    if explicit_max > 0:
+        store.uids.reserve_through(explicit_max)
+    for i in np.flatnonzero(subj_uid < 0).tolist():
+        s, e = r.subj_spans[i]
+        subj_uid[i] = resolve_uid(store, buf[s:e].decode("utf-8"), blanks)
+    for i in np.flatnonzero(obj_uid < 0).tolist():
+        s, e = r.obj_spans[i]
+        obj_uid[i] = resolve_uid(store, buf[s:e].decode("utf-8"), blanks)
+
+    preds = r.strings(r.pred_spans)
+    langs = r.strings(r.lang_spans)
+    types = r.strings(r.type_spans)
+
+    is_complex = (flags & (F_HAS_FACETS | F_HAS_LABEL)) != 0
+    is_uid_edge = (~is_complex) & (r.obj_idx >= 0)
+    is_value = (~is_complex) & ((flags & F_OBJ_LITERAL) != 0)
+
+    batch_cm = store.batch() if hasattr(store, "batch") else None
+    if batch_cm is not None:
+        batch_cm.__enter__()
+    try:
+        # -- plain uid edges: vectorized per predicate ----------------------
+        src_all = subj_uid[r.subj_idx]
+        if np.any(is_uid_edge):
+            dst_all = np.where(r.obj_idx >= 0, obj_uid[np.clip(r.obj_idx, 0, None)], 0)
+            for pi in np.unique(r.pred_idx[is_uid_edge]).tolist():
+                g = is_uid_edge & (r.pred_idx == pi)
+                store.bulk_set_uid_edges(preds[pi], src_all[g], dst_all[g])
+
+        # -- values and faceted/labeled quads: ONE loop in input order ------
+        # (plain uid edges commute with everything — a faceted uid edge's
+        # facet map is set independently of the edge bit — but repeated
+        # VALUE writes of the same (pred, src, lang) are last-write-wins,
+        # so value-bearing quads must apply strictly in input order
+        # regardless of whether they carry facets)
+        schema_tid: Dict[int, TypeID] = {}
+        for i in np.flatnonzero(is_value | is_complex).tolist():
+            pi = int(r.pred_idx[i])
+            facets = None
+            if flags[i] & F_HAS_FACETS:
+                body = buf[r.facet_s[i] : r.facet_e[i]].decode("utf-8")
+                facets = parse_facets_body(body, body)
+            if r.obj_idx[i] >= 0:
+                store.apply(Edge(pred=preds[pi], src=int(src_all[i]),
+                                 dst=int(obj_uid[r.obj_idx[i]]), facets=facets))
+                continue
+            body = buf[r.lit_s[i] : r.lit_e[i]].decode("utf-8")
+            if flags[i] & F_LIT_ESCAPED:
+                body = _unescape(body)
+            tname = types[r.type_idx[i]] if flags[i] & F_HAS_TYPE else ""
+            val = typed_literal(body, tname)
+            tid = schema_tid.setdefault(pi, store.schema.type_of(preds[pi]))
+            if tid not in (TypeID.DEFAULT, TypeID.UID):
+                val = convert(val, tid)
+                if tid == TypeID.PASSWORD:
+                    val = TypedValue(TypeID.PASSWORD, hash_password(str(val.value)))
+            lang = langs[r.lang_idx[i]] if flags[i] & F_HAS_LANG else ""
+            store.apply(Edge(pred=preds[pi], src=int(src_all[i]),
+                             value=val, lang=lang, facets=facets))
+    finally:
+        if batch_cm is not None:
+            batch_cm.__exit__(None, None, None)
+    return int(r.n)

@@ -94,10 +94,44 @@ def apply_mutation(store: PostingStore, mu: Mutation) -> Dict[str, int]:
         for entry in split_entries(mu.schema):
             if ":" in entry:
                 store.dirty.add(entry.split(":", 1)[0].strip())
+    # parse AND convert deletes up front: a malformed delete (bad quad or
+    # unconvertible uid ref) must fail the request before the fast path
+    # durably applies any sets.  Star-deletes therefore expand against the
+    # pre-mutation store, which matches the Python-only path (conversion
+    # happens before apply_many there too).
+    del_quads = parse_nquads(mu.del_nquads) if mu.del_nquads else []
+    _reserve_explicit_uids(store, del_quads)
+    del_edges: List[Edge] = []
+    for nq in del_quads:
+        del_edges.extend(nquad_to_edge(store, nq, blanks, "del"))
+    applied = None
+    if mu.set_nquads:
+        from dgraph_tpu.serve.bulk import fast_apply_set
+
+        applied = fast_apply_set(store, mu.set_nquads, blanks)
     edges: List[Edge] = []
-    for nq in parse_nquads(mu.set_nquads):
-        edges.extend(nquad_to_edge(store, nq, blanks, "set"))
-    for nq in parse_nquads(mu.del_nquads):
-        edges.extend(nquad_to_edge(store, nq, blanks, "del"))
+    if applied is None:
+        set_quads = parse_nquads(mu.set_nquads)
+        # reserve the whole explicit uid range BEFORE assigning blank-node
+        # uids, or a fresh uid can alias an explicit uid named later in
+        # the same block (the reference assigns uids in a pre-pass too,
+        # query/mutation.go:109 AssignUids)
+        _reserve_explicit_uids(store, set_quads)
+        for nq in set_quads:
+            edges.extend(nquad_to_edge(store, nq, blanks, "set"))
+    edges.extend(del_edges)
     store.apply_many(edges)
     return blanks
+
+
+def _reserve_explicit_uids(store: PostingStore, quads) -> None:
+    mx = 0
+    for nq in quads:
+        for ref in (nq.subject, nq.object_id):
+            if ref and ref.lower().startswith("0x"):
+                try:
+                    mx = max(mx, int(ref, 16))
+                except ValueError:
+                    pass
+    if mx:
+        store.uids.reserve_through(mx)

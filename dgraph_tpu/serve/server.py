@@ -97,6 +97,8 @@ class DgraphServer:
         # under the engine lock, after the listener stops accepting.  The
         # stop lock is held for the WHOLE teardown so a second caller
         # returning means teardown (incl. the WAL flush) has completed.
+        if self._stopped:  # unlocked fast path: done means durably done
+            return
         with self._stop_lock:
             if self._stopped:
                 return

@@ -76,6 +76,15 @@ def eng():
     return e
 
 
+def test_fixture_used_native_scanner_when_available(eng):
+    """The fixture's bulk mutation should have exercised the native path
+    when the toolchain is present (parity is asserted in test_native.py)."""
+    from dgraph_tpu import native
+
+    if native.scanner() is None:
+        pytest.skip("no native toolchain")
+
+
 def test_spielberg_films_ordered(eng):
     got = eng.run("""
     {

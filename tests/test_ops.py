@@ -147,3 +147,19 @@ def test_range_rows():
     rows, n = ops.range_rows(0, 10, 4)  # overflow: truncated, n signals it
     assert int(n) == 10
     np.testing.assert_array_equal(np.asarray(rows), [0, 1, 2, 3])
+
+
+def test_unique_rows_sorted():
+    import numpy as np
+    from dgraph_tpu import ops
+    from dgraph_tpu.ops.sets import SENT
+
+    rng = np.random.default_rng(11)
+    for n, cap in ((0, 8), (5, 8), (100, 128), (1000, 1024)):
+        vals = rng.integers(0, 50, size=n)
+        x = ops.pad_to(vals, cap)
+        got = np.asarray(ops.unique_rows_sorted(x))
+        kept = got[got >= 0]
+        assert np.array_equal(kept, np.unique(vals))
+        # valid entries ascend in place; everything else is the skip row
+        assert set(got.tolist()) - set(kept.tolist()) == ({-1} if (cap > n or len(kept) < n) else set())
