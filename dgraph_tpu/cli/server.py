@@ -43,6 +43,8 @@ def build_options(argv=None) -> Options:
     p.add_argument("--my", dest="my_addr", default=d.my_addr)
     p.add_argument("--trace", dest="trace_ratio", type=float, default=d.trace_ratio)
     p.add_argument("--expose_trace", action="store_true", default=d.expose_trace)
+    p.add_argument("--tls_cert", default=d.tls_cert)
+    p.add_argument("--tls_key", default=d.tls_key)
     p.add_argument("--workers", type=int, default=d.workers)
     p.add_argument("--num_pending", type=int, default=d.num_pending)
     p.add_argument("--max_edges", type=int, default=d.max_edges)
@@ -64,6 +66,8 @@ def main(argv=None) -> int:
         export_path=opts.export_path,
         trace_ratio=opts.trace_ratio,
         expose_trace=opts.expose_trace,
+        tls_cert=opts.tls_cert,
+        tls_key=opts.tls_key,
     )
     srv.start()
     print(f"dgraph-tpu serving at {srv.addr}  (dashboard at /, queries at /query)")

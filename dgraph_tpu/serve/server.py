@@ -51,6 +51,8 @@ class DgraphServer:
         export_path: str = "export",
         trace_ratio: float = 0.0,
         expose_trace: bool = True,
+        tls_cert: str = "",
+        tls_key: str = "",
     ):
         self.store = store
         self.engine = QueryEngine(store)
@@ -70,12 +72,28 @@ class DgraphServer:
         self._thread: Optional[threading.Thread] = None
         self._bind = bind
         self._port = port
+        self._tls_cert = tls_cert
+        self._tls_key = tls_key
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((self._bind, self._port), handler)
+        if self._tls_cert:
+            # TLS termination (x/tls_helper.go analog): stdlib ssl, TLS1.2+.
+            # do_handshake_on_connect=False moves the handshake off the
+            # accept loop into the per-connection handler thread (with its
+            # socket timeout) — a stalled client must not block accept()
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+            ctx.load_cert_chain(self._tls_cert, self._tls_key or None)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
         self._port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="dgraph-http", daemon=True
@@ -89,7 +107,8 @@ class DgraphServer:
 
     @property
     def addr(self) -> str:
-        return f"http://{self._bind}:{self._port}"
+        scheme = "https" if self._tls_cert else "http"
+        return f"{scheme}://{self._bind}:{self._port}"
 
     def stop(self) -> None:
         # idempotent (admin endpoint + signal handler can both call it) and
@@ -155,6 +174,20 @@ class DgraphServer:
 def _make_handler(srv: DgraphServer):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        timeout = 60  # bounds reads AND the deferred TLS handshake below
+
+        def setup(self):
+            super().setup()
+            # deferred TLS handshake, in this connection's thread and
+            # under this connection's timeout
+            import ssl
+
+            if isinstance(self.request, ssl.SSLSocket):
+                try:
+                    self.request.do_handshake()
+                except (ssl.SSLError, OSError):
+                    self.close_connection = True
+                    raise
         server_version = "dgraph-tpu/0.1"
 
         def log_message(self, *a):  # quiet

@@ -20,6 +20,8 @@ class Options:
     # serving
     port: int = 8080
     bind: str = "127.0.0.1"
+    tls_cert: str = ""   # PEM cert chain; empty = plain HTTP (x/tls_helper.go analog)
+    tls_key: str = ""    # PEM key; empty = key inside tls_cert
     # cluster identity (mirrors --idx/--groups/--peer)
     raft_id: int = 1
     group_ids: str = "0"

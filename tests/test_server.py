@@ -146,3 +146,41 @@ def test_yaml_config_values_survive(tmp_path):
     assert opts.port == 7001
     opts = build_options(["--config", str(cfg), "--port", "7002"])
     assert opts.port == 7002 and opts.sync_writes is True
+
+
+def test_tls_serving(tmp_path):
+    """HTTPS termination (reference x/tls_helper.go, contrib/tlstest)."""
+    import ssl
+    import subprocess
+    import urllib.request
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    try:
+        r = subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=localhost"],
+            capture_output=True,
+        )
+    except FileNotFoundError:
+        pytest.skip("openssl unavailable")
+    if r.returncode != 0:
+        pytest.skip("openssl unavailable")
+    from dgraph_tpu.models import PostingStore
+    from dgraph_tpu.serve.server import DgraphServer
+
+    srv = DgraphServer(PostingStore(), tls_cert=str(cert), tls_key=str(key))
+    srv.start()
+    try:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        req = urllib.request.Request(
+            f"https://127.0.0.1:{srv.port}/query",
+            data=b'mutation { set { <0x1> <name> "tls" . } }',
+        )
+        with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
+            assert b"Success" in resp.read()
+    finally:
+        srv.stop()
