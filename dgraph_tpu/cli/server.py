@@ -58,7 +58,26 @@ def build_options(argv=None) -> Options:
 
 def main(argv=None) -> int:
     opts = build_options(argv)
-    store = DurableStore(opts.postings_dir, sync_writes=opts.sync_writes)
+    cluster = None
+    if opts.peer:
+        # clustered boot (StartRaftNodes analog): durability lives in the
+        # raft logs + snapshots under the postings dir
+        from dgraph_tpu.cluster.service import ClusterService, parse_peers
+
+        scheme = "https" if opts.tls_cert else "http"
+        my_addr = opts.my_addr or f"{scheme}://127.0.0.1:{opts.port}"
+        cluster = ClusterService(
+            node_id=str(opts.raft_id),
+            my_addr=my_addr,
+            peers=parse_peers(opts.peer),
+            group_ids=[int(g) for g in opts.group_ids.split(",") if g.strip()],
+            directory=opts.postings_dir,
+            sync_writes=opts.sync_writes,
+        )
+        cluster.start()
+        store = cluster.store
+    else:
+        store = DurableStore(opts.postings_dir, sync_writes=opts.sync_writes)
     srv = DgraphServer(
         store,
         port=opts.port,
@@ -68,6 +87,7 @@ def main(argv=None) -> int:
         expose_trace=opts.expose_trace,
         tls_cert=opts.tls_cert,
         tls_key=opts.tls_key,
+        cluster=cluster,
     )
     srv.start()
     print(f"dgraph-tpu serving at {srv.addr}  (dashboard at /, queries at /query)")

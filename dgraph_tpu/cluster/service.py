@@ -1,0 +1,458 @@
+"""Cluster service: the server binary's StartRaftNodes analog.
+
+Wires the cluster layer into a serving process (reference
+worker/groups.go:109 StartRaftNodes + dgraph/server.go storage bring-up):
+
+- one ReplicatedGroup (Raft node + replica store) per group this server
+  serves, talking to peers over HttpRaftTransport (POST /raft/<group>);
+- group 0 is the metadata group (worker/groups.go:404): schema text, uid
+  leases (LEASE records) and xid assignments (XID records) replicate
+  through it;
+- data predicates route to groups by GroupConfig (group/conf.go rules);
+- `ClusterStore` — the store facade handed to the query engine: writes
+  become Raft proposals to the owning group (MutateOverNetwork's
+  proposeOrSend, worker/mutation.go:319 — non-leaders forward over HTTP
+  to the leader); reads come from per-predicate SNAPSHOT copies of the
+  local replica stores, refreshed when the replica applies new records,
+  so queries never race the raft apply threads (the reference's
+  immutable-layer read semantics).
+
+Reads are local-replica reads: any server answers queries from its own
+replicas (AnyServer read balancing, worker/groups.go:268) — writes are
+linearizable through Raft, reads are eventually consistent, as in the
+reference.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+from dgraph_tpu.models import codec
+from dgraph_tpu.models.store import Edge, PostingStore, PredicateData
+from dgraph_tpu.models.schema import SchemaState
+from dgraph_tpu.cluster.groups import GroupConfig
+from dgraph_tpu.cluster.lease import LeaseManager
+from dgraph_tpu.cluster.raft import NotLeaderError
+from dgraph_tpu.cluster.replica import ReplicatedGroup, encode_batch
+from dgraph_tpu.cluster.transport import HttpRaftTransport, decode_msg
+
+METADATA_GROUP = 0
+
+
+def parse_peers(peer_spec: str) -> Dict[str, str]:
+    """"1@host:8080,2@host:8081" (or full http:// urls) → id→addr."""
+    out: Dict[str, str] = {}
+    for part in peer_spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "@" not in part:
+            raise ValueError(f"peer {part!r} must be id@host:port")
+        nid, addr = part.split("@", 1)
+        if not addr.startswith(("http://", "https://")):
+            addr = "http://" + addr
+        out[nid.strip()] = addr
+    return out
+
+
+class ClusterService:
+    """Owns this server's raft groups, transport, lease and store facade."""
+
+    def __init__(
+        self,
+        node_id: str,
+        my_addr: str,
+        peers: Dict[str, str],          # id -> addr, INCLUDING self
+        group_ids: List[int],
+        directory: str,
+        group_config: Optional[GroupConfig] = None,
+        sync_writes: bool = False,
+        **raft_opts,
+    ):
+        if METADATA_GROUP not in group_ids:
+            group_ids = [METADATA_GROUP] + list(group_ids)
+        self.node_id = node_id
+        self.peers = dict(peers)
+        self.peers.setdefault(node_id, my_addr)
+        data_groups = sorted(g for g in group_ids if g != METADATA_GROUP)
+        if group_config is not None:
+            self.conf = group_config
+        elif data_groups:
+            # contiguous data groups 1..N: fingerprint mod N + 1
+            self.conf = GroupConfig.parse(f"default: fp % {len(data_groups)} + 1")
+        else:
+            self.conf = GroupConfig.single_group()
+        self.transport = HttpRaftTransport(
+            {nid: a for nid, a in self.peers.items() if nid != node_id}
+        )
+        peer_ids = sorted(self.peers)
+        self.groups: Dict[int, ReplicatedGroup] = {
+            g: ReplicatedGroup(
+                node_id=node_id, group=g, peers=peer_ids, directory=directory,
+                transport=self.transport, sync_writes=sync_writes, **raft_opts,
+            )
+            for g in group_ids
+        }
+        self.lease = LeaseManager(self._propose_lease)
+        self.store = ClusterStore(self)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        for g in self.groups.values():
+            g.start()
+        # resume the lease above everything the metadata replica has seen
+        meta = self.groups[METADATA_GROUP].store
+        self.lease.init_from_recovery(meta.uids.max_uid + 1)
+
+    def stop(self) -> None:
+        for g in self.groups.values():
+            g.stop()
+        self.transport.stop()
+
+    def has_leader(self) -> bool:
+        return all(g.node.leader_id is not None for g in self.groups.values())
+
+    # -- raft plane (server endpoints call these) ---------------------------
+
+    def deliver(self, group: int, body: bytes) -> None:
+        g = self.groups.get(group)
+        if g is not None:
+            g.node.deliver(decode_msg(body))
+
+    def propose_local(self, group: int, batch: bytes, timeout: float = 10.0) -> None:
+        """Propose on THIS server; raises NotLeaderError for the forwarder."""
+        self.groups[group].node.propose_and_wait(batch, timeout)
+
+    def propose_records(
+        self, group: int, records: List[bytes], timeout: float = 10.0
+    ) -> None:
+        """Propose, forwarding to the leader over HTTP when we're not it
+        (proposeOrSend: local → ProposeAndWait, remote → RPC)."""
+        batch = encode_batch(records)
+        self._route_to_leader(
+            lambda: self.propose_local(group, batch, timeout),
+            lambda peer: self._forward(peer, group, batch, timeout),
+        )
+
+    def _route_to_leader(
+        self,
+        local_fn: Callable[[], object],
+        forward_fn: Callable[[str], Tuple[object, Optional[str], bool]],
+    ):
+        """The shared leader-chasing loop: try locally, follow leader
+        hints, fall back to untried peers; bounded attempts.
+
+        ``forward_fn(peer) -> (result, leader_hint, ok)``."""
+        tried: set = set()
+        target: Optional[str] = None  # None = local
+        for _ in range(4):
+            if target is None or target == self.node_id:
+                try:
+                    return local_fn()
+                except NotLeaderError as e:
+                    tried.add(self.node_id)
+                    target = e.leader or self._next_untried(tried)
+            else:
+                result, hint, ok = forward_fn(target)
+                if ok:
+                    return result
+                tried.add(target)
+                target = hint or self._next_untried(tried)
+            if target is None:
+                break
+        raise NotLeaderError(None)
+
+    def _next_untried(self, tried: set) -> Optional[str]:
+        for nid in sorted(self.peers):
+            if nid not in tried:
+                return nid
+        return None
+
+    def _forward(self, peer: str, group: int, batch: bytes, timeout: float):
+        url = f"{self.peers[peer]}/raft-propose/{group}"
+        req = urllib.request.Request(
+            url, data=batch, headers={"Content-Type": "application/octet-stream"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout + 2) as resp:
+                resp.read()
+                return None, None, True
+        except urllib.error.HTTPError as e:
+            if e.code == 409:  # not the leader; body is the hint (or empty)
+                hint = e.read().decode("utf-8").strip()
+                return None, (hint or None), False
+            return None, None, False
+        except OSError:
+            return None, None, False
+
+    def _propose_lease(self, new_max: int) -> None:
+        self.propose_records(METADATA_GROUP, [codec.encode_lease(new_max)])
+
+    # -- uid assignment (leader-only, worker/assign.go:59) ------------------
+
+    def assign_local(self, n: int):
+        """Assign n uids on THIS server; only the metadata leader may
+        (assignUids asserts leadership, worker/assign.go:37)."""
+        node = self.groups[METADATA_GROUP].node
+        if not node.is_leader:
+            raise NotLeaderError(node.leader_id)
+        # a freshly-elected leader resumes above every lease any previous
+        # leader durably recorded (resetLease on leader change, lease.go:57)
+        meta_next = self.groups[METADATA_GROUP].store.uids.max_uid + 1
+        if self.lease._leased < meta_next:
+            self.lease.init_from_recovery(meta_next)
+        return self.lease.assign(n)
+
+    def assign_uids(self, n: int):
+        """Route assignment to the metadata leader (AssignUidsOverNetwork)."""
+        return self._route_to_leader(
+            lambda: self.assign_local(n),
+            lambda peer: self._forward_assign(peer, n),
+        )
+
+    def _forward_assign(self, peer: str, n: int):
+        url = f"{self.peers[peer]}/assign-uids"
+        req = urllib.request.Request(url, data=str(n).encode())
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                import json
+
+                got = json.loads(resp.read())
+                return (int(got["start"]), int(got["end"])), None, True
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                hint = e.read().decode("utf-8").strip()
+                return None, (hint or None), False
+            return None, None, False
+        except OSError:
+            return None, None, False
+
+
+class _ClusterUids:
+    """uid allocation facade: fresh uids via the replicated lease, xids via
+    XID records on the metadata group (worker/assign.go semantics)."""
+
+    def __init__(self, svc: ClusterService):
+        self._svc = svc
+
+    @property
+    def _meta(self):
+        return self._svc.groups[METADATA_GROUP].store.uids
+
+    @property
+    def max_uid(self) -> int:
+        return max(self._svc.lease.max_assigned, self._meta.max_uid)
+
+    def __len__(self) -> int:
+        return len(self._meta)
+
+    def fresh(self, n: int = 1) -> List[int]:
+        start, end = self._svc.assign_uids(n)
+        return list(range(start, end + 1))
+
+    def assign(self, xid: str) -> int:
+        existing = self._meta.lookup(xid)
+        if existing is not None:
+            return existing
+        uid = self.fresh(1)[0]
+        self._svc.propose_records(METADATA_GROUP, [codec.encode_xid(xid, uid)])
+        # the applied map is authoritative (first XID record in log order
+        # wins on every replica); on a follower the local apply can lag the
+        # leader's commit, so wait for our record to land
+        import time
+
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            got = self._meta.lookup(xid)
+            if got is not None:
+                return got
+            time.sleep(0.005)
+        return uid
+
+    def lookup(self, xid: str) -> Optional[int]:
+        return self._meta.lookup(xid)
+
+    def assign_many(self, xids) -> List[int]:
+        return [self.assign(x) for x in xids]
+
+    def reserve_through(self, uid: int) -> None:
+        """Explicit uids must push the lease so fresh uids never collide.
+        Extensions batch by min_lease so ascending explicit-uid workloads
+        don't pay one raft round per mutation block (minLeaseNum,
+        lease.go:88-98)."""
+        lease = self._svc.lease
+        if uid >= lease._leased:
+            new_max = max(uid + 1, lease._leased + lease.min_lease)
+            self._svc._propose_lease(new_max)
+            with lease._lock:
+                lease._leased = max(lease._leased, new_max)
+                lease._next = max(lease._next, uid + 1)
+        else:
+            with lease._lock:
+                lease._next = max(lease._next, uid + 1)
+
+    def snapshot(self) -> Dict[str, int]:
+        return self._meta.snapshot()
+
+
+class ClusterStore:
+    """The engine-facing store: replicated writes, snapshot-stable reads.
+
+    Implements PostingStore's read/write surface (duck-typed — the engine
+    and serving layer never isinstance-check)."""
+
+    def __init__(self, svc: ClusterService):
+        self._svc = svc
+        self.uids = _ClusterUids(svc)
+        self._dirty: set = set()
+        self._snaps: Dict[str, PredicateData] = {}
+        self._snap_lock = threading.Lock()
+
+    @property
+    def dirty(self) -> set:
+        """Drains the replicas' dirty marks on every read so consumers that
+        watch ``store.dirty`` directly (ArenaManager.refresh) see replica
+        applies without a peek() having run first."""
+        with self._snap_lock:
+            self._drain_dirty()
+            return self._dirty
+
+    # -- schema (metadata group) -------------------------------------------
+
+    @property
+    def schema(self) -> SchemaState:
+        return self._svc.groups[METADATA_GROUP].store.schema
+
+    def apply_schema(self, text: str) -> None:
+        from dgraph_tpu.models.schema import parse_schema
+
+        parse_schema(text, into=SchemaState())  # validate before proposing
+        self._svc.propose_records(METADATA_GROUP, [codec.encode_schema(text)])
+
+    # -- reads (snapshot copies of local replicas) --------------------------
+
+    def _owner_gid(self, pred: str) -> int:
+        gid = self._svc.conf.belongs_to(pred)
+        return gid if gid in self._svc.groups else METADATA_GROUP
+
+    def _owner(self, pred: str) -> ReplicatedGroup:
+        return self._svc.groups[self._owner_gid(pred)]
+
+    def _drain_dirty(self) -> None:
+        """Caller holds _snap_lock."""
+        for g in self._svc.groups.values():
+            with g._lock:
+                if g.store.dirty:
+                    self._dirty |= g.store.dirty
+                    if "*" in g.store.dirty:
+                        # full-store replacement (raft snapshot restore):
+                        # every cached snapshot is stale
+                        self._snaps.clear()
+                    else:
+                        for p in g.store.dirty:
+                            self._snaps.pop(p, None)
+                    g.store.dirty.clear()
+
+    def peek(self, name: str) -> Optional[PredicateData]:
+        with self._snap_lock:
+            self._drain_dirty()
+            snap = self._snaps.get(name)
+            if snap is None:
+                g = self._owner(name)
+                with g._lock:
+                    live = g.store.peek(name)
+                    if live is None:
+                        return None
+                    snap = _copy_pred(live)
+                self._snaps[name] = snap
+            return snap
+
+    def pred(self, name: str) -> PredicateData:
+        return self.peek(name) or PredicateData()
+
+    def predicates(self) -> List[str]:
+        out: set = set()
+        for g in self._svc.groups.values():
+            with g._lock:
+                out.update(g.store._preds.keys())
+        return sorted(out)
+
+    def value(self, pred: str, uid: int, lang: str = ""):
+        p = self.peek(pred)
+        if p is None:
+            return None
+        v = p.values.get((uid, lang))
+        if v is None and lang:
+            v = p.values.get((uid, ""))
+        return v
+
+    def any_value(self, pred: str, uid: int):
+        p = self.peek(pred)
+        if p is None:
+            return None
+        v = p.values.get((uid, ""))
+        if v is not None:
+            return v
+        for (u, _l), val in p.values.items():
+            if u == uid:
+                return val
+        return None
+
+    def neighbors(self, pred: str, uid: int) -> List[int]:
+        p = self.peek(pred)
+        if p is None:
+            return []
+        return sorted(p.edges.get(uid, ()))
+
+    def edge_count(self) -> int:
+        return sum(
+            sum(len(s) for s in p.edges.values()) + len(p.values)
+            for g in self._svc.groups.values()
+            for p in list(g.store._preds.values())
+        )
+
+    # -- writes (raft proposals, partitioned by owning group) --------------
+
+    def apply(self, e: Edge) -> None:
+        self.apply_many([e])
+
+    def apply_many(self, edges) -> int:
+        by_group: Dict[int, List[bytes]] = {}
+        n = 0
+        for e in edges:
+            by_group.setdefault(self._owner_gid(e.pred), []).append(
+                codec.encode_edge(e)
+            )
+            n += 1
+        for gid, records in by_group.items():
+            self._svc.propose_records(gid, records)
+        return n
+
+    def bulk_set_uid_edges(self, pred: str, src, dst) -> None:
+        self._svc.propose_records(
+            self._owner_gid(pred), [codec.encode_bulk_edges(pred, src, dst)]
+        )
+
+    def delete_predicate(self, pred: str) -> None:
+        self._svc.propose_records(
+            self._owner_gid(pred), [codec.encode_delpred(pred)]
+        )
+
+    def set_edge(self, pred: str, src: int, dst: int, facets=None):
+        self.apply(Edge(pred=pred, src=src, dst=dst, facets=facets))
+
+    def del_edge(self, pred: str, src: int, dst: int):
+        self.apply(Edge(pred=pred, src=src, dst=dst, op="del"))
+
+
+def _copy_pred(p: PredicateData) -> PredicateData:
+    out = PredicateData()
+    out.edges = {u: set(s) for u, s in p.edges.items()}
+    out.values = dict(p.values)
+    out.edge_facets = {k: dict(v) for k, v in p.edge_facets.items()}
+    out.value_facets = {k: dict(v) for k, v in p.value_facets.items()}
+    return out

@@ -1,0 +1,204 @@
+"""Raft message wire codec + HTTP transport between server processes.
+
+Equivalent of the reference's raft gRPC plane (worker/draft.go:437
+batchAndSendMessages → grpc RaftMessage:1017): messages are length-framed
+binary (the shared varint codec — NOT pickle: raft frames arrive off the
+network and must never execute anything), queued per peer and shipped by
+a sender thread so the raft event loop never blocks on the network.
+Delivery is best-effort; raft tolerates loss and the queue drops when a
+peer is down (the reference's conn pool likewise drops on dead conns).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import urllib.request
+from typing import Dict, List, Optional
+
+from dgraph_tpu.models import codec
+from dgraph_tpu.cluster.raft import (
+    AppendReq,
+    AppendResp,
+    Entry,
+    SnapshotReq,
+    SnapshotResp,
+    Transport,
+    VoteReq,
+    VoteResp,
+)
+
+_VOTE_REQ, _VOTE_RESP, _APPEND_REQ, _APPEND_RESP, _SNAP_REQ, _SNAP_RESP = range(6)
+
+
+def _put_bytes(buf: bytearray, b: bytes) -> None:
+    codec.put_uvarint(buf, len(b))
+    buf.extend(b)
+
+
+def _get_bytes(b: bytes, pos: int):
+    n, pos = codec.uvarint(b, pos)
+    return bytes(b[pos : pos + n]), pos + n
+
+
+def _put_str(buf: bytearray, s: str) -> None:
+    _put_bytes(buf, s.encode("utf-8"))
+
+
+def _get_str(b: bytes, pos: int):
+    raw, pos = _get_bytes(b, pos)
+    return raw.decode("utf-8"), pos
+
+
+def encode_msg(msg) -> bytes:
+    buf = bytearray()
+    if isinstance(msg, VoteReq):
+        buf.append(_VOTE_REQ)
+        codec.put_uvarint(buf, msg.term)
+        _put_str(buf, msg.candidate)
+        codec.put_uvarint(buf, msg.last_log_index)
+        codec.put_uvarint(buf, msg.last_log_term)
+    elif isinstance(msg, VoteResp):
+        buf.append(_VOTE_RESP)
+        codec.put_uvarint(buf, msg.term)
+        buf.append(1 if msg.granted else 0)
+        _put_str(buf, msg.sender)
+    elif isinstance(msg, AppendReq):
+        buf.append(_APPEND_REQ)
+        codec.put_uvarint(buf, msg.term)
+        _put_str(buf, msg.leader)
+        codec.put_uvarint(buf, msg.prev_log_index)
+        codec.put_uvarint(buf, msg.prev_log_term)
+        codec.put_uvarint(buf, msg.leader_commit)
+        codec.put_uvarint(buf, len(msg.entries))
+        for e in msg.entries:
+            codec.put_uvarint(buf, e.term)
+            codec.put_uvarint(buf, e.index)
+            _put_bytes(buf, e.data)
+    elif isinstance(msg, AppendResp):
+        buf.append(_APPEND_RESP)
+        codec.put_uvarint(buf, msg.term)
+        buf.append(1 if msg.success else 0)
+        codec.put_uvarint(buf, msg.match_index)
+        _put_str(buf, msg.sender)
+    elif isinstance(msg, SnapshotReq):
+        buf.append(_SNAP_REQ)
+        codec.put_uvarint(buf, msg.term)
+        _put_str(buf, msg.leader)
+        codec.put_uvarint(buf, msg.last_index)
+        codec.put_uvarint(buf, msg.last_term)
+        _put_bytes(buf, msg.data)
+    elif isinstance(msg, SnapshotResp):
+        buf.append(_SNAP_RESP)
+        codec.put_uvarint(buf, msg.term)
+        _put_str(buf, msg.sender)
+        codec.put_uvarint(buf, msg.last_index)
+    else:
+        raise TypeError(f"unknown raft message {type(msg)!r}")
+    return bytes(buf)
+
+
+def decode_msg(b: bytes):
+    tag = b[0]
+    pos = 1
+    if tag == _VOTE_REQ:
+        term, pos = codec.uvarint(b, pos)
+        cand, pos = _get_str(b, pos)
+        lli, pos = codec.uvarint(b, pos)
+        llt, pos = codec.uvarint(b, pos)
+        return VoteReq(term, cand, lli, llt)
+    if tag == _VOTE_RESP:
+        term, pos = codec.uvarint(b, pos)
+        granted = b[pos] == 1
+        sender, pos = _get_str(b, pos + 1)
+        return VoteResp(term, granted, sender)
+    if tag == _APPEND_REQ:
+        term, pos = codec.uvarint(b, pos)
+        leader, pos = _get_str(b, pos)
+        pli, pos = codec.uvarint(b, pos)
+        plt, pos = codec.uvarint(b, pos)
+        commit, pos = codec.uvarint(b, pos)
+        n, pos = codec.uvarint(b, pos)
+        entries: List[Entry] = []
+        for _ in range(n):
+            et, pos = codec.uvarint(b, pos)
+            ei, pos = codec.uvarint(b, pos)
+            data, pos = _get_bytes(b, pos)
+            entries.append(Entry(et, ei, data))
+        return AppendReq(term, leader, pli, plt, entries, commit)
+    if tag == _APPEND_RESP:
+        term, pos = codec.uvarint(b, pos)
+        success = b[pos] == 1
+        match, pos = codec.uvarint(b, pos + 1)
+        sender, pos = _get_str(b, pos)
+        return AppendResp(term, success, match, sender)
+    if tag == _SNAP_REQ:
+        term, pos = codec.uvarint(b, pos)
+        leader, pos = _get_str(b, pos)
+        li, pos = codec.uvarint(b, pos)
+        lt, pos = codec.uvarint(b, pos)
+        data, pos = _get_bytes(b, pos)
+        return SnapshotReq(term, leader, li, lt, data)
+    if tag == _SNAP_RESP:
+        term, pos = codec.uvarint(b, pos)
+        sender, pos = _get_str(b, pos)
+        li, pos = codec.uvarint(b, pos)
+        return SnapshotResp(term, sender, li)
+    raise ValueError(f"unknown raft message tag {tag:#x}")
+
+
+class HttpRaftTransport(Transport):
+    """Ships raft frames to peers over HTTP POST /raft/<group>.
+
+    One bounded queue + daemon sender thread per peer: the raft loop
+    enqueues and returns; slow/dead peers drop frames instead of
+    applying backpressure to consensus (batchAndSendMessages behavior,
+    draft.go:434 'no need to send heartbeats if we can't send messages').
+    """
+
+    def __init__(self, addr_of: Dict[str, str], timeout: float = 2.0):
+        self.addr_of = dict(addr_of)      # node_id -> http(s)://host:port
+        self.timeout = timeout
+        self._queues: Dict[str, "queue.Queue"] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def _queue_for(self, peer: str) -> "queue.Queue":
+        with self._lock:
+            q = self._queues.get(peer)
+            if q is None:
+                q = queue.Queue(maxsize=256)
+                self._queues[peer] = q
+                t = threading.Thread(
+                    target=self._sender, args=(peer, q),
+                    name=f"raft-send-{peer}", daemon=True,
+                )
+                t.start()
+            return q
+
+    def send(self, to: str, group: int, msg) -> None:
+        if to not in self.addr_of:
+            return
+        try:
+            self._queue_for(to).put_nowait((group, encode_msg(msg)))
+        except queue.Full:
+            pass  # drop: raft retries via next heartbeat
+
+    def _sender(self, peer: str, q: "queue.Queue") -> None:
+        while not self._stop.is_set():
+            try:
+                group, body = q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            url = f"{self.addr_of[peer]}/raft/{group}"
+            try:
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/octet-stream"},
+                )
+                urllib.request.urlopen(req, timeout=self.timeout).read()
+            except OSError:
+                pass  # peer down: drop, heartbeats will retry
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -118,7 +118,10 @@ def apply_record(store: PostingStore, payload: bytes) -> None:
     elif tag == codec.XID:
         xid, pos = codec.get_str(payload, 1)
         uid, _ = codec.uvarint(payload, pos)
-        store.uids._xid_to_uid[xid] = uid
+        # first write wins: concurrent assigns of one xid race their XID
+        # records through the metadata group; applying in log order with
+        # setdefault makes every replica agree on the winner
+        store.uids._xid_to_uid.setdefault(xid, uid)
         store.uids.reserve_through(uid)
     elif tag == codec.LEASE:
         nxt, _ = codec.uvarint(payload, 1)

@@ -116,6 +116,7 @@ def fast_apply_set(
         # so value-bearing quads must apply strictly in input order
         # regardless of whether they carry facets)
         schema_tid: Dict[int, TypeID] = {}
+        ordered_edges = []
         for i in np.flatnonzero(is_value | is_complex).tolist():
             pi = int(r.pred_idx[i])
             facets = None
@@ -123,8 +124,9 @@ def fast_apply_set(
                 body = buf[r.facet_s[i] : r.facet_e[i]].decode("utf-8")
                 facets = parse_facets_body(body, body)
             if r.obj_idx[i] >= 0:
-                store.apply(Edge(pred=preds[pi], src=int(src_all[i]),
-                                 dst=int(obj_uid[r.obj_idx[i]]), facets=facets))
+                ordered_edges.append(
+                    Edge(pred=preds[pi], src=int(src_all[i]),
+                         dst=int(obj_uid[r.obj_idx[i]]), facets=facets))
                 continue
             body = buf[r.lit_s[i] : r.lit_e[i]].decode("utf-8")
             if flags[i] & F_LIT_ESCAPED:
@@ -137,8 +139,12 @@ def fast_apply_set(
                 if tid == TypeID.PASSWORD:
                     val = TypedValue(TypeID.PASSWORD, hash_password(str(val.value)))
             lang = langs[r.lang_idx[i]] if flags[i] & F_HAS_LANG else ""
-            store.apply(Edge(pred=preds[pi], src=int(src_all[i]),
-                             value=val, lang=lang, facets=facets))
+            ordered_edges.append(Edge(pred=preds[pi], src=int(src_all[i]),
+                                      value=val, lang=lang, facets=facets))
+        # one batched apply: a single WAL flush standalone, one proposal
+        # batch per group under replication
+        if ordered_edges:
+            store.apply_many(ordered_edges)
     finally:
         if batch_cm is not None:
             batch_cm.__exit__(None, None, None)

@@ -53,7 +53,9 @@ class DgraphServer:
         expose_trace: bool = True,
         tls_cert: str = "",
         tls_key: str = "",
+        cluster=None,
     ):
+        self.cluster = cluster  # ClusterService when clustered, else None
         self.store = store
         self.engine = QueryEngine(store)
         self.health = HealthGate()
@@ -127,6 +129,8 @@ class DgraphServer:
                 self._httpd.server_close()
                 self._httpd = None
             with self._engine_lock:
+                if self.cluster is not None:
+                    self.cluster.stop()
                 if hasattr(self.store, "close"):
                     self.store.close()
             self._stopped = True
@@ -260,6 +264,47 @@ def _make_handler(srv: DgraphServer):
         def do_POST(self):
             u = urlparse(self.path)
             n = int(self.headers.get("Content-Length", 0))
+            if u.path == "/assign-uids":
+                # leader-only uid leasing (AssignUidsOverNetwork target)
+                raw = self.rfile.read(n)
+                if srv.cluster is None:
+                    return self._err(404, "not clustered")
+                from dgraph_tpu.cluster.raft import NotLeaderError
+
+                try:
+                    start, end = srv.cluster.assign_local(int(raw or b"1"))
+                except NotLeaderError as e:
+                    return self._reply(409, (e.leader or "").encode(), "text/plain")
+                except Exception as e:
+                    return self._err(400, str(e))
+                return self._reply(
+                    200, json.dumps({"start": start, "end": end}).encode()
+                )
+            if u.path.startswith("/raft/") or u.path.startswith("/raft-propose/"):
+                # raft plane: binary frames, no engine lock (RaftMessage /
+                # proposeOrSend endpoints, draft.go:1017, mutation.go:319)
+                raw = self.rfile.read(n)
+                if srv.cluster is None:
+                    return self._err(404, "not clustered")
+                try:
+                    gid = int(u.path.rsplit("/", 1)[1])
+                except ValueError:
+                    return self._err(400, "bad group")
+                if u.path.startswith("/raft/"):
+                    try:
+                        srv.cluster.deliver(gid, raw)
+                    except Exception as e:
+                        return self._err(400, str(e))
+                    return self._reply(200, b"{}")
+                from dgraph_tpu.cluster.raft import NotLeaderError
+
+                try:
+                    srv.cluster.propose_local(gid, raw)
+                except NotLeaderError as e:
+                    return self._reply(409, (e.leader or "").encode(), "text/plain")
+                except Exception as e:
+                    return self._err(500, str(e))
+                return self._reply(200, b"{}")
             body = self.rfile.read(n).decode("utf-8", "replace")
             if u.path == "/query":
                 qs = parse_qs(u.query)
