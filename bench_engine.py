@@ -1,0 +1,136 @@
+"""Engine-latency benchmark: the wiki performance-page queries.
+
+Mirrors the reference's published query latencies (BASELINE.md:
+3-hop Tom-Hanks-style co-actor query 2-3ms warm / 8-9ms cold;
+4-level Spielberg detail query 30-35ms warm / 87ms cold, on an i7
+laptop over the Freebase 21M film graph).  Builds a synthetic film
+graph at configurable scale, bulk-loads it through the real mutation
+path (native scanner when available), and measures the same two query
+shapes through parse → execute → JSON.
+
+Usage: python bench_engine.py            (env: BE_DIRECTORS, BE_RUNS)
+Prints one JSON line per query shape.
+"""
+
+import json
+import os
+import random
+import time
+
+from dgraph_tpu.models import PostingStore
+from dgraph_tpu.query import QueryEngine
+
+SCHEMA = """
+    name: string @index(term, exact) .
+    initial_release_date: datetime @index(year) .
+    director.film: uid @reverse @count .
+    genre: uid @reverse .
+    starring: uid .
+    performance.actor: uid @reverse .
+"""
+
+
+def build(n_directors: int, films_per: int = 8, actors_per_film: int = 6,
+          n_actors: int | None = None, seed: int = 7) -> str:
+    rng = random.Random(seed)
+    n_actors = n_actors or n_directors * 3
+    lines = []
+    uid = 1
+
+    def u(x):
+        return f"<0x{x:x}>"
+
+    genres = []
+    for gi in range(24):
+        genres.append(uid)
+        lines.append(f'{u(uid)} <name> "Genre {gi}" .')
+        uid += 1
+    actors = []
+    for ai in range(n_actors):
+        actors.append(uid)
+        lines.append(f'{u(uid)} <name> "Actor {ai}" .')
+        uid += 1
+    for di in range(n_directors):
+        d = uid
+        uid += 1
+        lines.append(f'{u(d)} <name> "Director {di}" .')
+        for fi in range(films_per):
+            f = uid
+            uid += 1
+            lines.append(f'{u(f)} <name> "Film {di}-{fi}" .')
+            y = 1960 + rng.randrange(60)
+            lines.append(f'{u(f)} <initial_release_date> "{y}-0{1 + rng.randrange(9)}-1{rng.randrange(9)}" .')
+            lines.append(f'{u(d)} <director.film> {u(f)} .')
+            lines.append(f'{u(f)} <genre> {u(rng.choice(genres))} .')
+            for _ in range(actors_per_film):
+                p = uid
+                uid += 1
+                a = rng.choice(actors)
+                lines.append(f'{u(p)} <performance.actor> {u(a)} .')
+                lines.append(f'{u(f)} <starring> {u(p)} .')
+    return "\n".join(lines)
+
+
+def main():
+    n_directors = int(os.environ.get("BE_DIRECTORS", 2000))
+    runs = int(os.environ.get("BE_RUNS", 20))
+
+    st = PostingStore()
+    eng = QueryEngine(st)
+    t0 = time.time()
+    rdf = build(n_directors)
+    gen_s = time.time() - t0
+    t0 = time.time()
+    eng.run("mutation { schema { %s } set { %s } }" % (SCHEMA, rdf))
+    load_s = time.time() - t0
+    n_quads = rdf.count("\n") + 1
+
+    # the two wiki shapes, seeded on a mid-graph entity
+    co_actor = """
+    { me(func: eq(name, "Actor 7")) {
+        ~performance.actor { ~starring {
+          name
+          starring { performance.actor { name } }
+        } }
+    } }"""
+    detail = """
+    { dir(func: eq(name, "Director 11")) {
+        name
+        director.film (orderasc: initial_release_date) {
+          name
+          initial_release_date
+          genre { name }
+          starring { performance.actor { name } }
+        }
+    } }"""
+
+    results = {}
+    for label, q in (("3hop_coactor", co_actor), ("4level_detail", detail)):
+        cold0 = time.time()
+        out = eng.run(q)
+        cold_ms = (time.time() - cold0) * 1e3
+        assert out, f"{label} returned empty"
+        times = []
+        for _ in range(runs):
+            t0 = time.time()
+            eng.run(q)
+            times.append((time.time() - t0) * 1e3)
+        times.sort()
+        results[label] = {
+            "cold_ms": round(cold_ms, 2),
+            "warm_p50_ms": round(times[len(times) // 2], 2),
+            "warm_min_ms": round(times[0], 2),
+        }
+
+    for label, r in results.items():
+        print(json.dumps({"metric": f"engine_{label}", **r}))
+    print(
+        f"# graph: {n_directors} directors, {n_quads} quads "
+        f"(gen {gen_s:.1f}s, load {load_s:.1f}s = {n_quads/load_s:,.0f} quads/s); "
+        f"{runs} warm runs. Reference (i7, 21M graph): 3hop 2-3ms warm / "
+        f"8-9ms cold; 4level 30-35ms warm / 87ms cold (BASELINE.md)."
+    )
+
+
+if __name__ == "__main__":
+    main()

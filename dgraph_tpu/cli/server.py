@@ -69,7 +69,7 @@ def main(argv=None) -> int:
         cluster = ClusterService(
             node_id=str(opts.raft_id),
             my_addr=my_addr,
-            peers=parse_peers(opts.peer),
+            peers=parse_peers(opts.peer, default_scheme=scheme),
             group_ids=[int(g) for g in opts.group_ids.split(",") if g.strip()],
             directory=opts.postings_dir,
             sync_writes=opts.sync_writes,

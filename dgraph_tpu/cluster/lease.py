@@ -48,6 +48,18 @@ class LeaseManager:
             # old lease, even if unused — monotonicity is the contract
             self._next = self._leased
 
+    def reserve_through(self, uid: int) -> None:
+        """Mark an explicitly-named uid as taken: the allocator must never
+        hand it out as a fresh uid.  Extends the durable lease (batched)
+        when the uid lies beyond the leased window; always advances the
+        allocation cursor past it."""
+        with self._lock:
+            if uid >= self._leased:
+                new_max = max(uid + 1, self._leased + self.min_lease)
+                self._propose(new_max)
+                self._leased = new_max
+            self._next = max(self._next, uid + 1)
+
     def assign(self, n: int) -> Tuple[int, int]:
         """Allocate n consecutive uids; returns [start, end] inclusive
         (AssignUids semantics, worker/assign.go:37)."""

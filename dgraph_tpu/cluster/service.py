@@ -37,13 +37,20 @@ from dgraph_tpu.cluster.groups import GroupConfig
 from dgraph_tpu.cluster.lease import LeaseManager
 from dgraph_tpu.cluster.raft import NotLeaderError
 from dgraph_tpu.cluster.replica import ReplicatedGroup, encode_batch
-from dgraph_tpu.cluster.transport import HttpRaftTransport, decode_msg
+from dgraph_tpu.cluster.transport import (
+    HttpRaftTransport,
+    decode_msg,
+    urlopen_peer,
+)
 
 METADATA_GROUP = 0
 
 
-def parse_peers(peer_spec: str) -> Dict[str, str]:
-    """"1@host:8080,2@host:8081" (or full http:// urls) → id→addr."""
+def parse_peers(peer_spec: str, default_scheme: str = "http") -> Dict[str, str]:
+    """"1@host:8080,2@host:8081" (or full http(s):// urls) → id→addr.
+    Bare host:port entries take ``default_scheme`` — a TLS-enabled server
+    must default its peers to https or raft frames hit TLS listeners as
+    plaintext and are silently dropped."""
     out: Dict[str, str] = {}
     for part in peer_spec.split(","):
         part = part.strip()
@@ -53,7 +60,7 @@ def parse_peers(peer_spec: str) -> Dict[str, str]:
             raise ValueError(f"peer {part!r} must be id@host:port")
         nid, addr = part.split("@", 1)
         if not addr.startswith(("http://", "https://")):
-            addr = "http://" + addr
+            addr = f"{default_scheme}://" + addr
         out[nid.strip()] = addr
     return out
 
@@ -178,7 +185,7 @@ class ClusterService:
             url, data=batch, headers={"Content-Type": "application/octet-stream"}
         )
         try:
-            with urllib.request.urlopen(req, timeout=timeout + 2) as resp:
+            with urlopen_peer(req, timeout + 2) as resp:
                 resp.read()
                 return None, None, True
         except urllib.error.HTTPError as e:
@@ -214,11 +221,31 @@ class ClusterService:
             lambda peer: self._forward_assign(peer, n),
         )
 
+    def reserve_local(self, uid: int) -> Tuple[int, int]:
+        """Leader-side explicit-uid reservation: the LEADER's allocation
+        cursor must skip uids named explicitly in mutations, even inside
+        the already-leased window — a follower-local note would let the
+        leader hand the same uid to a blank node later."""
+        node = self.groups[METADATA_GROUP].node
+        if not node.is_leader:
+            raise NotLeaderError(node.leader_id)
+        meta_next = self.groups[METADATA_GROUP].store.uids.max_uid + 1
+        if self.lease._leased < meta_next:
+            self.lease.init_from_recovery(meta_next)
+        self.lease.reserve_through(uid)
+        return (uid, uid)
+
+    def reserve_uid(self, uid: int) -> None:
+        self._route_to_leader(
+            lambda: self.reserve_local(uid),
+            lambda peer: self._forward_assign(peer, -uid),  # negative = reserve
+        )
+
     def _forward_assign(self, peer: str, n: int):
         url = f"{self.peers[peer]}/assign-uids"
         req = urllib.request.Request(url, data=str(n).encode())
         try:
-            with urllib.request.urlopen(req, timeout=10) as resp:
+            with urlopen_peer(req, 10) as resp:
                 import json
 
                 got = json.loads(resp.read())
@@ -280,20 +307,11 @@ class _ClusterUids:
         return [self.assign(x) for x in xids]
 
     def reserve_through(self, uid: int) -> None:
-        """Explicit uids must push the lease so fresh uids never collide.
-        Extensions batch by min_lease so ascending explicit-uid workloads
-        don't pay one raft round per mutation block (minLeaseNum,
-        lease.go:88-98)."""
-        lease = self._svc.lease
-        if uid >= lease._leased:
-            new_max = max(uid + 1, lease._leased + lease.min_lease)
-            self._svc._propose_lease(new_max)
-            with lease._lock:
-                lease._leased = max(lease._leased, new_max)
-                lease._next = max(lease._next, uid + 1)
-        else:
-            with lease._lock:
-                lease._next = max(lease._next, uid + 1)
+        """Explicit uids route to the metadata LEADER's allocator (like
+        fresh assignment): only its cursor decides future uids, so a
+        follower-local note would not prevent aliasing.  Lease extensions
+        batch by min_lease (minLeaseNum, lease.go:88-98)."""
+        self._svc.reserve_uid(uid)
 
     def snapshot(self) -> Dict[str, int]:
         return self._meta.snapshot()
@@ -409,11 +427,14 @@ class ClusterStore:
         return sorted(p.edges.get(uid, ()))
 
     def edge_count(self) -> int:
-        return sum(
-            sum(len(s) for s in p.edges.values()) + len(p.values)
-            for g in self._svc.groups.values()
-            for p in list(g.store._preds.values())
-        )
+        total = 0
+        for g in self._svc.groups.values():
+            with g._lock:  # raft applies mutate these dicts concurrently
+                total += sum(
+                    sum(len(s) for s in p.edges.values()) + len(p.values)
+                    for p in g.store._preds.values()
+                )
+        return total
 
     # -- writes (raft proposals, partitioned by owning group) --------------
 

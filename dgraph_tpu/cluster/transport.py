@@ -31,6 +31,21 @@ from dgraph_tpu.cluster.raft import (
 _VOTE_REQ, _VOTE_RESP, _APPEND_REQ, _APPEND_RESP, _SNAP_REQ, _SNAP_RESP = range(6)
 
 
+def urlopen_peer(req, timeout: float):
+    """urlopen for intra-cluster calls: https peers typically run on
+    self-signed certs (contrib/tlstest-style), so TLS is used for
+    transport privacy without peer-certificate verification.  CA pinning
+    is a config knob the reference's tls_helper exposes; not wired yet."""
+    url = req.full_url if hasattr(req, "full_url") else str(req)
+    if url.startswith("https://"):
+        import ssl
+
+        return urllib.request.urlopen(
+            req, timeout=timeout, context=ssl._create_unverified_context()
+        )
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
 def _put_bytes(buf: bytearray, b: bytes) -> None:
     codec.put_uvarint(buf, len(b))
     buf.extend(b)
@@ -196,7 +211,7 @@ class HttpRaftTransport(Transport):
                     url, data=body,
                     headers={"Content-Type": "application/octet-stream"},
                 )
-                urllib.request.urlopen(req, timeout=self.timeout).read()
+                urlopen_peer(req, self.timeout).read()
             except OSError:
                 pass  # peer down: drop, heartbeats will retry
 

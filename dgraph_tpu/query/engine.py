@@ -491,24 +491,27 @@ class QueryEngine:
             self._collect_vars(c, uid_vars, value_vars)
 
     def _aggregate(self, child: SubGraph, src: np.ndarray, value_vars):
-        """min/max/sum/avg over a value variable (valueVarAggregation)."""
+        """min/max/sum/avg over a value variable (valueVarAggregation).
+        min/max preserve the operand type (min of datetimes is a datetime,
+        query/aggregator.go ApplyVal); sum/avg promote to numeric."""
         v = child.needs_var[0] if child.needs_var else ""
         vmap = value_vars.get(v, {})
-        nums = [numeric(tv) for tv in vmap.values()]
-        nums = [x for x in nums if x is not None]
-        if not nums:
-            child.values = {}
-            return
         fn = child.params.agg_func
-        if fn == "min":
-            r = min(nums)
-        elif fn == "max":
-            r = max(nums)
-        elif fn == "sum":
-            r = sum(nums)
+        if fn in ("min", "max"):
+            vals = list(vmap.values())
+            if not vals:
+                child.values = {}
+                return
+            pick = min if fn == "min" else max
+            tv = pick(vals, key=sort_key)
         else:
-            r = sum(nums) / len(nums)
-        tv = TypedValue(TypeID.FLOAT, float(r))
+            nums = [numeric(tv) for tv in vmap.values()]
+            nums = [x for x in nums if x is not None]
+            if not nums:
+                child.values = {}
+                return
+            r = sum(nums) if fn == "sum" else sum(nums) / len(nums)
+            tv = TypedValue(TypeID.FLOAT, float(r))
         # one value for the block (reference emits it on the block root)
         child.values = {int(u): tv for u in src.tolist()} or {0: tv}
         if child.params.var:

@@ -272,7 +272,11 @@ def _make_handler(srv: DgraphServer):
                 from dgraph_tpu.cluster.raft import NotLeaderError
 
                 try:
-                    start, end = srv.cluster.assign_local(int(raw or b"1"))
+                    want = int(raw or b"1")
+                    if want < 0:  # negative = reserve an explicit uid
+                        start, end = srv.cluster.reserve_local(-want)
+                    else:
+                        start, end = srv.cluster.assign_local(want)
                 except NotLeaderError as e:
                     return self._reply(409, (e.leader or "").encode(), "text/plain")
                 except Exception as e:

@@ -156,3 +156,23 @@ def test_leader_failover(cluster3):
         for o in _post(survivors[1].addr, "/query",
                        '{ q(func: has(kind)) { kind } }').get("q", [])
     ))
+
+
+def test_explicit_uid_reservation_reaches_leader(cluster3):
+    """An explicit uid written through a FOLLOWER must never be handed out
+    later as a fresh uid by the metadata leader, even when it falls inside
+    the leader's already-leased window."""
+    from dgraph_tpu.cluster.service import METADATA_GROUP
+
+    leader = next(
+        s for s in cluster3 if s.cluster.groups[METADATA_GROUP].node.is_leader
+    )
+    follower = next(s for s in cluster3 if s is not leader)
+    # leader leases a window and starts allocating from its bottom
+    leader.cluster.assign_uids(1)
+    explicit = 0x40
+    follower.cluster.store.uids.reserve_through(explicit)
+    start, end = leader.cluster.assign_uids(200)
+    assert not (start <= explicit <= end), (
+        f"leader handed out reserved uid {explicit:#x} in [{start}, {end}]"
+    )

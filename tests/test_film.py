@@ -264,3 +264,16 @@ def test_shortest_path_film_graph(eng):
         node = nxt[0][0]
         hops += 1
     assert hops == 4
+
+
+def test_min_max_preserve_type(eng):
+    """min/max over a datetime value var must stay a datetime
+    (query/aggregator.go ApplyVal), not collapse to epoch floats."""
+    got = eng.run("""
+    {
+      var(func: has(initial_release_date)) { d as initial_release_date }
+      stats() { min(val(d)) max(val(d)) }
+    }""")
+    s = got["stats"][0]
+    assert s["min(val(d))"].startswith("1975-06-20")
+    assert s["max(val(d))"].startswith("2004-06-18")
