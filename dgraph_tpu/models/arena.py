@@ -188,12 +188,18 @@ class ArenaManager:
     arenas for clean predicates stay resident on device between queries.
     """
 
-    def __init__(self, store: PostingStore):
+    def __init__(self, store: PostingStore, mesh=None, shard_threshold: int = 4096):
         self.store = store
+        # device mesh for uid-range row sharding of big predicates (the
+        # intra-predicate sharding the reference lacks, SURVEY.md §5);
+        # None = single-device execution
+        self.mesh = mesh
+        self.shard_threshold = shard_threshold
         self._data: Dict[str, CSRArena] = {}
         self._reverse: Dict[str, CSRArena] = {}
         self._index: Dict[Tuple[str, str], IndexArena] = {}
         self._values: Dict[str, ValueArena] = {}
+        self._sharded: Dict[Tuple[str, bool], tuple] = {}
 
     def refresh(self):
         """Drop cached arenas for predicates mutated since last refresh."""
@@ -205,6 +211,7 @@ class ArenaManager:
             self._reverse.clear()
             self._values.clear()
             self._index.clear()
+            self._sharded.clear()
             dirty.clear()
             return
         for p in list(dirty):
@@ -212,9 +219,34 @@ class ArenaManager:
                 self._data.pop(key, None)
             self._reverse.pop(p, None)
             self._values.pop(p, None)
+            self._sharded.pop((p, False), None)
+            self._sharded.pop((p, True), None)
             for key in [k for k in self._index if k[0] == p]:
                 self._index.pop(key, None)
         dirty.clear()
+
+    # -- mesh sharding -------------------------------------------------------
+
+    def sharded_csr(self, pred: str, reverse: bool = False):
+        """Row-sharded view of a predicate's CSR over the mesh's 'model'
+        axis, cached against the source arena's identity (rebuilds follow
+        the same dirty invalidation as the arena itself)."""
+        from dgraph_tpu.parallel.mesh import shard_arena_rows
+
+        a = self.reverse(pred) if reverse else self.data(pred)
+        key = (pred, reverse)
+        cached = self._sharded.get(key)
+        if cached is not None and cached[0] is a:
+            return cached[1]
+        n_model = self.mesh.shape["model"]
+        sa = shard_arena_rows(
+            a.h_src, a.h_offsets, np.asarray(a.dst)[: a.n_edges], n_model
+        )
+        self._sharded[key] = (a, sa)
+        return sa
+
+    def use_mesh_for(self, arena: CSRArena) -> bool:
+        return self.mesh is not None and arena.n_rows >= self.shard_threshold
 
     # -- data / reverse ----------------------------------------------------
 

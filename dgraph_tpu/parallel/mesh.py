@@ -114,6 +114,55 @@ def sharded_expand_step(mesh: Mesh, cap: int):
     return jax.jit(fn)
 
 
+def seg_expand_step(mesh: Mesh, cap: int):
+    """Segment-preserving sharded expansion: frontier [B] (replicated) →
+    (out, seg) [n_model, cap] where seg is the index into the frontier
+    that produced each slot.  This is the engine's uid_matrix contract
+    (task.proto Result.uid_matrix) under row sharding: each device
+    expands only the frontier uids whose rows it owns, then the shards'
+    segments are all_gathered and reassembled host-side."""
+
+    def local_expand(src, offsets, dst, frontier):
+        src, offsets, dst = src[0], offsets[0], dst[0]
+        rows = ops.rows_of(src, frontier)
+        out, seg, _t = ops.expand_csr(offsets, dst, rows, cap)
+        return (
+            jax.lax.all_gather(out, "model"),
+            jax.lax.all_gather(seg, "model"),
+        )
+
+    fn = shard_map(
+        local_expand,
+        mesh=mesh,
+        in_specs=(P("model", None), P("model", None), P("model", None), P()),
+        out_specs=(P(), P()),
+        check_rep=False,
+    )
+    return jax.jit(fn)
+
+
+def sharded_expand_segments(
+    mesh: Mesh, sharded: ShardedArena, frontier: np.ndarray, cap: int
+):
+    """One engine-level expansion over the mesh: returns (out_flat,
+    seg_ptr) identical in content to the single-device expand — each
+    frontier uid's targets ascending, grouped in frontier order."""
+    fcap = ops.bucket(max(1, len(frontier)))
+    f = jnp.asarray(ops.pad_to(np.asarray(frontier, dtype=np.int64), fcap))
+    step = seg_expand_step(mesh, cap)
+    outs, segs = step(sharded.src, sharded.offsets, sharded.dst, f)
+    out = np.asarray(outs).reshape(-1)
+    seg = np.asarray(segs).reshape(-1)
+    valid = seg >= 0
+    out, seg = out[valid], seg[valid]
+    order = np.argsort(seg, kind="stable")  # shards own disjoint rows, so
+    out, seg = out[order], seg[order]       # per-segment order survives
+    counts = np.bincount(seg, minlength=len(frontier))[: len(frontier)]
+    seg_ptr = np.zeros(len(frontier) + 1, dtype=np.int64)
+    np.cumsum(counts, out=seg_ptr[1:])
+    return out.astype(np.int64), seg_ptr
+
+
 def sharded_two_hop(mesh: Mesh, arena: ShardedArena, frontier: np.ndarray, cap1: int, cap2: int):
     """Two-hop sharded traversal: returns (hop1 uids, hop2 uids) padded."""
     step1 = sharded_expand_step(mesh, cap1)

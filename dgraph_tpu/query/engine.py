@@ -34,9 +34,9 @@ class QueryEngine:
     layer serializes, as the reference does per-request goroutines over
     shared immutable posting state)."""
 
-    def __init__(self, store: PostingStore):
+    def __init__(self, store: PostingStore, mesh=None, shard_threshold: int = 4096):
         self.store = store
-        self.arenas = ArenaManager(store)
+        self.arenas = ArenaManager(store, mesh=mesh, shard_threshold=shard_threshold)
 
     # -- public ------------------------------------------------------------
 
@@ -286,7 +286,7 @@ class QueryEngine:
 
         # uid expansion on device
         arena = self.arenas.reverse(attr) if child.reverse else self.arenas.data(attr)
-        out_flat, seg_ptr = self._expand(arena, src)
+        out_flat, seg_ptr = self._expand(arena, src, attr=attr, reverse=child.reverse)
         child.src_uids = src
         child.out_flat = out_flat
         child.seg_ptr = seg_ptr
@@ -308,9 +308,14 @@ class QueryEngine:
             return
         self._exec_children(child, resolver, uid_vars, value_vars)
 
-    def _expand(self, arena, src: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    def _expand(
+        self, arena, src: np.ndarray, attr: str = "", reverse: bool = False
+    ) -> Tuple[np.ndarray, np.ndarray]:
         """One batched device gather for a whole level (the TPU replacement
-        for the reference's per-key loop, worker/task.go:287-440)."""
+        for the reference's per-key loop, worker/task.go:287-440).  Big
+        predicates on a multi-device mesh expand sharded: each device owns
+        a uid range of rows, results merge via all_gather (SURVEY §2b —
+        intra-predicate sharding the reference lacks)."""
         n = len(src)
         if n == 0 or arena.n_edges == 0:
             return _EMPTY, np.zeros(n + 1, dtype=np.int64)
@@ -319,6 +324,11 @@ class QueryEngine:
         if total == 0:
             return _EMPTY, np.zeros(n + 1, dtype=np.int64)
         cap = ops.bucket(total)
+        if attr and self.arenas.use_mesh_for(arena):
+            from dgraph_tpu.parallel.mesh import sharded_expand_segments
+
+            sharded = self.arenas.sharded_csr(attr, reverse=reverse)
+            return sharded_expand_segments(self.arenas.mesh, sharded, src, cap)
         out, seg, _t = ops.expand_csr(
             arena.offsets, arena.dst, ops.pad_rows(rows, ops.bucket(n)), cap
         )

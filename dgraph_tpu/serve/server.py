@@ -57,7 +57,7 @@ class DgraphServer:
     ):
         self.cluster = cluster  # ClusterService when clustered, else None
         self.store = store
-        self.engine = QueryEngine(store)
+        self.engine = QueryEngine(store, mesh=_auto_mesh())
         self.health = HealthGate()
         self.tracer = Tracer(trace_ratio)
         self.export_path = export_path
@@ -173,6 +173,23 @@ class DgraphServer:
     def _run_locked(self, parsed, out: dict) -> None:
         with self._engine_lock:
             out.update(self.engine.run_parsed(parsed))
+
+
+def _auto_mesh():
+    """A ("data","model") mesh over all local devices when more than one
+    is visible (TPU pod slice / virtual CPU mesh); big predicates then
+    expand row-sharded.  DGRAPH_TPU_MESH=off disables."""
+    import os
+
+    if os.environ.get("DGRAPH_TPU_MESH", "auto") == "off":
+        return None
+    import jax
+
+    if len(jax.devices()) < 2:
+        return None
+    from dgraph_tpu.parallel import make_mesh
+
+    return make_mesh(len(jax.devices()), data=1)
 
 
 def _make_handler(srv: DgraphServer):
