@@ -7,14 +7,78 @@ measured against a fully-vectorized NumPy implementation of the same
 semantics (the stand-in for the reference's CPU posting-list walk).
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
-Environment knobs: BENCH_NODES, BENCH_EDGES, BENCH_SEEDS, BENCH_ITERS.
+Environment knobs: BENCH_NODES, BENCH_EDGES, BENCH_SEEDS, BENCH_ITERS,
+BENCH_SCALE (shrink everything by a factor: 0.1 -> 200k nodes / 2.1M
+edges), BENCH_PROBE_TIMEOUT / BENCH_INIT_RETRIES (backend probe knobs).
+
+Robustness contract (round-1 postmortem: the round artifact was empty
+because a wedged TPU turned into an unhandled stack dump): the TPU
+backend is probed in a SUBPROCESS with a hard timeout — a wedged chip
+hangs inside C++ where no Python-level timeout can fire — with retries
+and backoff; if it never comes up we say so in one stderr line and fall
+back to XLA-on-CPU so the round still records a real (if unflattering)
+number.  A mid-run failure retries once at BENCH_SCALE/8.
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+_PROBE = (
+    "import jax; d = jax.devices(); import jax.numpy as jnp; "
+    "x = jnp.ones((256, 256)); jax.block_until_ready(x @ x); "
+    "print(d[0].platform)"
+)
+
+
+def ensure_backend() -> str:
+    """Probe the default (TPU) backend out-of-process with a timeout;
+    fall back to CPU after retries.  Returns the platform chosen."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # env var alone is not enough: this image's sitecustomize imports
+        # jax at interpreter startup, consuming JAX_PLATFORMS before user
+        # env can influence it — config.update works until backend init
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu"
+    retries = int(os.environ.get("BENCH_INIT_RETRIES", 3))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+    last = ""
+    for attempt in range(retries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE],
+                capture_output=True,
+                text=True,
+                timeout=probe_timeout,
+            )
+            if r.returncode == 0:
+                return r.stdout.strip().splitlines()[-1]
+            last = (r.stderr.strip().splitlines() or ["rc=%d" % r.returncode])[-1]
+        except subprocess.TimeoutExpired:
+            last = f"probe hung >{probe_timeout:.0f}s (backend wedged?)"
+        if attempt < retries - 1:
+            delay = 5 * (2**attempt)
+            print(
+                f"# backend probe {attempt + 1}/{retries} failed ({last}); "
+                f"retrying in {delay}s",
+                file=sys.stderr,
+            )
+            time.sleep(delay)
+    print(
+        f"# TPU backend unavailable after {retries} probes ({last}); "
+        "falling back to XLA-on-CPU",
+        file=sys.stderr,
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
 
 
 def build_graph(n_nodes: int, n_edges: int, seed: int = 7):
@@ -55,15 +119,15 @@ def np_two_hop(a, h_dst, frontier):
     return len(out1) + len(out2), np.unique(out2)
 
 
-def main():
+def run_bench(scale: float):
     import jax
     import jax.numpy as jnp
     from dgraph_tpu import ops
     from dgraph_tpu.ops.sets import SENT
 
-    n_nodes = int(os.environ.get("BENCH_NODES", 2_000_000))
-    n_edges = int(os.environ.get("BENCH_EDGES", 21_000_000))
-    n_seeds = int(os.environ.get("BENCH_SEEDS", 4096))
+    n_nodes = max(1024, int(int(os.environ.get("BENCH_NODES", 2_000_000)) * scale))
+    n_edges = max(4096, int(int(os.environ.get("BENCH_EDGES", 21_000_000)) * scale))
+    n_seeds = max(64, int(int(os.environ.get("BENCH_SEEDS", 4096)) * min(1.0, scale * 4)))
     iters = int(os.environ.get("BENCH_ITERS", 20))
 
     t0 = time.time()
@@ -151,8 +215,27 @@ def main():
         f"# graph: {n_nodes} nodes / {a.n_edges} edges (build {build_s:.1f}s); "
         f"{iters} queries x {n_seeds} seeds; device {dev_s:.2f}s "
         f"({dev_eps/1e6:.1f}M e/s) vs numpy {cpu_s:.2f}s ({cpu_eps/1e6:.1f}M e/s) "
-        f"on {jax.devices()[0].platform}",
+        f"on {jax.devices()[0].platform}; scale={scale:g}",
     )
+
+
+def main():
+    platform = ensure_backend()
+    print(f"# backend: {platform}", file=sys.stderr)
+    scale = float(os.environ.get("BENCH_SCALE", 1.0))
+    try:
+        run_bench(scale)
+    except AssertionError:
+        raise  # correctness failures must never be masked by a retry
+    except Exception as e:
+        first = str(e).strip().splitlines()
+        first = first[0] if first else type(e).__name__
+        print(
+            f"# bench failed at scale={scale:g} ({type(e).__name__}: {first}); "
+            f"retrying once at scale={scale / 8:g}",
+            file=sys.stderr,
+        )
+        run_bench(scale / 8)
 
 
 if __name__ == "__main__":

@@ -45,6 +45,16 @@ def build_options(argv=None) -> Options:
     p.add_argument("--expose_trace", action="store_true", default=d.expose_trace)
     p.add_argument("--tls_cert", default=d.tls_cert)
     p.add_argument("--tls_key", default=d.tls_key)
+    p.add_argument("--cluster_secret", default=d.cluster_secret,
+                   help="shared secret required on intra-cluster endpoints "
+                        "(/raft*, /assign-uids); empty disables the gate")
+    p.add_argument("--peer_ca", default=d.peer_ca,
+                   help="PEM CA bundle to verify peer TLS certs against "
+                        "(CA pinning for the raft plane)")
+    p.add_argument("--peer_tls_insecure", action="store_true",
+                   default=d.peer_tls_insecure,
+                   help="explicitly skip peer TLS verification "
+                        "(throwaway self-signed clusters only)")
     p.add_argument("--workers", type=int, default=d.workers)
     p.add_argument("--num_pending", type=int, default=d.num_pending)
     p.add_argument("--max_edges", type=int, default=d.max_edges)
@@ -73,7 +83,20 @@ def main(argv=None) -> int:
             group_ids=[int(g) for g in opts.group_ids.split(",") if g.strip()],
             directory=opts.postings_dir,
             sync_writes=opts.sync_writes,
+            secret=opts.cluster_secret,
+            peer_ca=opts.peer_ca,
+            peer_tls_insecure=opts.peer_tls_insecure,
         )
+        has_https_peer = any(
+            a.startswith("https://") for a in cluster.peers.values()
+        )
+        if has_https_peer and not opts.peer_ca and not opts.peer_tls_insecure:
+            print(
+                "warning: TLS peers will be verified against the system "
+                "trust store; for self-signed cluster certs pass --peer_ca "
+                "(pin) or --peer_tls_insecure",
+                file=sys.stderr,
+            )
         cluster.start()
         store = cluster.store
     else:

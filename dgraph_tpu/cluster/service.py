@@ -39,6 +39,7 @@ from dgraph_tpu.cluster.raft import NotLeaderError
 from dgraph_tpu.cluster.replica import ReplicatedGroup, encode_batch
 from dgraph_tpu.cluster.transport import (
     HttpRaftTransport,
+    PeerAuth,
     decode_msg,
     urlopen_peer,
 )
@@ -77,6 +78,9 @@ class ClusterService:
         directory: str,
         group_config: Optional[GroupConfig] = None,
         sync_writes: bool = False,
+        secret: str = "",
+        peer_ca: str = "",
+        peer_tls_insecure: bool = False,
         **raft_opts,
     ):
         if METADATA_GROUP not in group_ids:
@@ -92,8 +96,10 @@ class ClusterService:
             self.conf = GroupConfig.parse(f"default: fp % {len(data_groups)} + 1")
         else:
             self.conf = GroupConfig.single_group()
+        self.auth = PeerAuth(secret=secret, cafile=peer_ca, insecure=peer_tls_insecure)
         self.transport = HttpRaftTransport(
-            {nid: a for nid, a in self.peers.items() if nid != node_id}
+            {nid: a for nid, a in self.peers.items() if nid != node_id},
+            auth=self.auth,
         )
         peer_ids = sorted(self.peers)
         self.groups: Dict[int, ReplicatedGroup] = {
@@ -185,7 +191,7 @@ class ClusterService:
             url, data=batch, headers={"Content-Type": "application/octet-stream"}
         )
         try:
-            with urlopen_peer(req, timeout + 2) as resp:
+            with urlopen_peer(req, timeout + 2, self.auth) as resp:
                 resp.read()
                 return None, None, True
         except urllib.error.HTTPError as e:
@@ -245,7 +251,7 @@ class ClusterService:
         url = f"{self.peers[peer]}/assign-uids"
         req = urllib.request.Request(url, data=str(n).encode())
         try:
-            with urlopen_peer(req, 10) as resp:
+            with urlopen_peer(req, 10, self.auth) as resp:
                 import json
 
                 got = json.loads(resp.read())
@@ -348,8 +354,31 @@ class ClusterStore:
     def apply_schema(self, text: str) -> None:
         from dgraph_tpu.models.schema import parse_schema
 
-        parse_schema(text, into=SchemaState())  # validate before proposing
+        want = parse_schema(text, into=SchemaState())  # validate first
         self._svc.propose_records(METADATA_GROUP, [codec.encode_schema(text)])
+        # On a follower the proposal is forwarded to the leader and the
+        # LOCAL apply can lag its commit; a set block in the same request
+        # would then convert values against the stale schema, durably
+        # storing wrong-typed values.  Wait until every proposed predicate
+        # is visible locally (same deadline pattern as _ClusterUids.assign;
+        # later schema records for the same predicate in log order simply
+        # overwrite, so observing our entries is sufficient).
+        import time
+
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            local = self.schema._preds
+            if all(local.get(p.name) == p for p in want._preds.values()):
+                return
+            time.sleep(0.005)
+        # the proposal IS durably committed at this point — only the local
+        # apply is lagging.  Say so precisely: retrying the whole request
+        # is safe (same-text schema records are idempotent overwrites), but
+        # the client must know the schema itself did not fail.
+        raise TimeoutError(
+            "schema change committed but not yet applied on this replica "
+            "after 5s; retry the request (idempotent) or query another server"
+        )
 
     # -- reads (snapshot copies of local replicas) --------------------------
 

@@ -30,19 +30,53 @@ from dgraph_tpu.cluster.raft import (
 
 _VOTE_REQ, _VOTE_RESP, _APPEND_REQ, _APPEND_RESP, _SNAP_REQ, _SNAP_RESP = range(6)
 
+# Header carrying the shared cluster secret on every intra-cluster call.
+# The raft/propose/assign endpoints share the public port (the reference
+# isolates them on an internal gRPC port); the secret is what stops
+# anyone with network reach from injecting forged raft frames.
+SECRET_HEADER = "X-Dgraph-Cluster-Secret"
 
-def urlopen_peer(req, timeout: float):
-    """urlopen for intra-cluster calls: https peers typically run on
-    self-signed certs (contrib/tlstest-style), so TLS is used for
-    transport privacy without peer-certificate verification.  CA pinning
-    is a config knob the reference's tls_helper exposes; not wired yet."""
+
+class PeerAuth:
+    """Security posture for intra-cluster calls: a shared secret attached
+    to every request, and the TLS trust model for https peers —
+    ``cafile`` pins a CA (chain verified, hostname check off: cluster
+    certs are typically issued to names that don't match peer IPs, the
+    reference's tls_helper has the same server-name override escape
+    hatch); ``insecure=True`` is the explicit opt-out for throwaway
+    self-signed setups; default is full system-store verification."""
+
+    def __init__(self, secret: str = "", cafile: str = "", insecure: bool = False):
+        self.secret = secret
+        self.cafile = cafile
+        self.insecure = insecure
+        self._ctx = None
+
+    def ssl_context(self):
+        if self._ctx is None:
+            import ssl
+
+            if self.cafile:
+                ctx = ssl.create_default_context(cafile=self.cafile)
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_REQUIRED
+            elif self.insecure:
+                ctx = ssl._create_unverified_context()
+            else:
+                ctx = ssl.create_default_context()
+            self._ctx = ctx
+        return self._ctx
+
+
+def urlopen_peer(req, timeout: float, auth: Optional[PeerAuth] = None):
+    """urlopen for intra-cluster calls: attaches the cluster secret and
+    applies the PeerAuth TLS trust model for https peers."""
+    if auth is not None and auth.secret and hasattr(req, "add_header"):
+        req.add_header(SECRET_HEADER, auth.secret)
     url = req.full_url if hasattr(req, "full_url") else str(req)
     if url.startswith("https://"):
-        import ssl
-
-        return urllib.request.urlopen(
-            req, timeout=timeout, context=ssl._create_unverified_context()
-        )
+        ctx = (auth or PeerAuth()).ssl_context()
+        return urllib.request.urlopen(req, timeout=timeout, context=ctx)
     return urllib.request.urlopen(req, timeout=timeout)
 
 
@@ -171,9 +205,15 @@ class HttpRaftTransport(Transport):
     draft.go:434 'no need to send heartbeats if we can't send messages').
     """
 
-    def __init__(self, addr_of: Dict[str, str], timeout: float = 2.0):
+    def __init__(
+        self,
+        addr_of: Dict[str, str],
+        timeout: float = 2.0,
+        auth: Optional[PeerAuth] = None,
+    ):
         self.addr_of = dict(addr_of)      # node_id -> http(s)://host:port
         self.timeout = timeout
+        self.auth = auth
         self._queues: Dict[str, "queue.Queue"] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -211,7 +251,7 @@ class HttpRaftTransport(Transport):
                     url, data=body,
                     headers={"Content-Type": "application/octet-stream"},
                 )
-                urlopen_peer(req, self.timeout).read()
+                urlopen_peer(req, self.timeout, self.auth).read()
             except OSError:
                 pass  # peer down: drop, heartbeats will retry
 

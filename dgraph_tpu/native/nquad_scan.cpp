@@ -41,8 +41,15 @@ struct SpanTable {
     }
 };
 
-inline bool is_ws(char c) { return c == ' ' || c == '\t' || c == '\r' || c == '\n'; }
-inline bool is_sp(char c) { return c == ' ' || c == '\t'; }
+// exactly Python's \s (re module, ASCII range): [ \t\n\r\f\v] — and is_sp
+// is [^\S\n] (horizontal whitespace).  Width parity with the Python
+// grammar matters: acceptance must not depend on whether g++ was present.
+inline bool is_ws(char c) {
+    return c == ' ' || c == '\t' || c == '\r' || c == '\n' || c == '\f' || c == '\v';
+}
+inline bool is_sp(char c) {
+    return c == ' ' || c == '\t' || c == '\r' || c == '\f' || c == '\v';
+}
 
 inline bool is_blank_char(char c) {
     return (c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z') ||
@@ -177,6 +184,9 @@ long nq_scan(const char* buf, long len, long max_quads,
         Kind sk = scan_ref(ss, se);
         if (sk == K_BAD || sk == K_LITERAL) return -(stmt_start + 1);
         if (sk == K_STAR) fl |= F_SUBJ_STAR;
+        // the Python grammar requires \s+ between terms (_QUAD_RE): zero
+        // whitespace must error here so both paths reject identically
+        if (pos < len && !is_ws(buf[pos])) return -(stmt_start + 1);
         while (pos < len && is_ws(buf[pos])) ++pos;
 
         // ---- predicate ------------------------------------------------
@@ -195,6 +205,7 @@ long nq_scan(const char* buf, long len, long max_quads,
         } else {
             return -(stmt_start + 1);
         }
+        if (pos < len && !is_ws(buf[pos])) return -(stmt_start + 1);  // \s+ again
         while (pos < len && is_ws(buf[pos])) ++pos;
 
         // ---- object ---------------------------------------------------
@@ -241,10 +252,12 @@ long nq_scan(const char* buf, long len, long max_quads,
             if (ok_ == K_BAD) return -(stmt_start + 1);
             if (ok_ == K_STAR) fl |= F_OBJ_STAR;
         }
+        long sp0 = pos;
         while (pos < len && is_sp(buf[pos])) ++pos;
 
-        // ---- optional label <g> --------------------------------------
+        // ---- optional label <g> (needs [^\S\n]+ before it) -----------
         if (pos < len && buf[pos] == '<') {
+            if (pos == sp0) return -(stmt_start + 1);
             int32_t gs, ge;
             if (scan_ref(gs, ge) != K_IRI) return -(stmt_start + 1);
             fl |= F_HAS_LABEL;

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 from typing import List, Tuple
 
 import jax
@@ -90,10 +90,16 @@ def shard_arena_rows(h_src: np.ndarray, h_offsets: np.ndarray, h_dst: np.ndarray
     )
 
 
+@lru_cache(maxsize=64)
 def sharded_expand_step(mesh: Mesh, cap: int):
     """Build the jitted one-hop step: frontier [B] (replicated) →
     next frontier [cap] (replicated), expanding each shard's owned rows
-    locally and combining via all_gather over 'model'."""
+    locally and combining via all_gather over 'model'.
+
+    Memoized on (mesh, cap): jax.jit caches on function identity, so a
+    fresh shard_map closure per call would re-trace and recompile XLA on
+    every serving-path expansion.  Mesh is hashable and caps are bucketed
+    powers of two, so the cache stays small."""
 
     def local_expand(src, offsets, dst, frontier):
         # src/offsets/dst: this shard's slice (leading dim 1 from shard_map)
@@ -114,8 +120,10 @@ def sharded_expand_step(mesh: Mesh, cap: int):
     return jax.jit(fn)
 
 
+@lru_cache(maxsize=64)
 def seg_expand_step(mesh: Mesh, cap: int):
-    """Segment-preserving sharded expansion: frontier [B] (replicated) →
+    """Segment-preserving sharded expansion (memoized on (mesh, cap) —
+    see sharded_expand_step): frontier [B] (replicated) →
     (out, seg) [n_model, cap] where seg is the index into the frontier
     that produced each slot.  This is the engine's uid_matrix contract
     (task.proto Result.uid_matrix) under row sharding: each device

@@ -97,50 +97,56 @@ def fast_apply_set(
     is_uid_edge = (~is_complex) & (r.obj_idx >= 0)
     is_value = (~is_complex) & ((flags & F_OBJ_LITERAL) != 0)
 
+    # -- values and faceted/labeled quads: build + validate ALL of them
+    # BEFORE the first durable write.  Facet parsing and schema type
+    # conversion can raise; the whole set block must fail all-or-nothing,
+    # matching the Python fallback (which converts in nquad_to_edge before
+    # apply_many).  Only after every quad validates do we touch the store.
+    #
+    # Ordering: plain uid edges commute with everything — a faceted uid
+    # edge's facet map is set independently of the edge bit — but repeated
+    # VALUE writes of the same (pred, src, lang) are last-write-wins, so
+    # value-bearing quads apply strictly in input order regardless of
+    # whether they carry facets.
+    src_all = subj_uid[r.subj_idx]
+    schema_tid: Dict[int, TypeID] = {}
+    ordered_edges = []
+    for i in np.flatnonzero(is_value | is_complex).tolist():
+        pi = int(r.pred_idx[i])
+        facets = None
+        if flags[i] & F_HAS_FACETS:
+            body = buf[r.facet_s[i] : r.facet_e[i]].decode("utf-8")
+            facets = parse_facets_body(body, body)
+        if r.obj_idx[i] >= 0:
+            ordered_edges.append(
+                Edge(pred=preds[pi], src=int(src_all[i]),
+                     dst=int(obj_uid[r.obj_idx[i]]), facets=facets))
+            continue
+        body = buf[r.lit_s[i] : r.lit_e[i]].decode("utf-8")
+        if flags[i] & F_LIT_ESCAPED:
+            body = _unescape(body)
+        tname = types[r.type_idx[i]] if flags[i] & F_HAS_TYPE else ""
+        val = typed_literal(body, tname)
+        tid = schema_tid.setdefault(pi, store.schema.type_of(preds[pi]))
+        if tid not in (TypeID.DEFAULT, TypeID.UID):
+            val = convert(val, tid)
+            if tid == TypeID.PASSWORD:
+                val = TypedValue(TypeID.PASSWORD, hash_password(str(val.value)))
+        lang = langs[r.lang_idx[i]] if flags[i] & F_HAS_LANG else ""
+        ordered_edges.append(Edge(pred=preds[pi], src=int(src_all[i]),
+                                  value=val, lang=lang, facets=facets))
+
     batch_cm = store.batch() if hasattr(store, "batch") else None
     if batch_cm is not None:
         batch_cm.__enter__()
     try:
         # -- plain uid edges: vectorized per predicate ----------------------
-        src_all = subj_uid[r.subj_idx]
         if np.any(is_uid_edge):
             dst_all = np.where(r.obj_idx >= 0, obj_uid[np.clip(r.obj_idx, 0, None)], 0)
             for pi in np.unique(r.pred_idx[is_uid_edge]).tolist():
                 g = is_uid_edge & (r.pred_idx == pi)
                 store.bulk_set_uid_edges(preds[pi], src_all[g], dst_all[g])
 
-        # -- values and faceted/labeled quads: ONE loop in input order ------
-        # (plain uid edges commute with everything — a faceted uid edge's
-        # facet map is set independently of the edge bit — but repeated
-        # VALUE writes of the same (pred, src, lang) are last-write-wins,
-        # so value-bearing quads must apply strictly in input order
-        # regardless of whether they carry facets)
-        schema_tid: Dict[int, TypeID] = {}
-        ordered_edges = []
-        for i in np.flatnonzero(is_value | is_complex).tolist():
-            pi = int(r.pred_idx[i])
-            facets = None
-            if flags[i] & F_HAS_FACETS:
-                body = buf[r.facet_s[i] : r.facet_e[i]].decode("utf-8")
-                facets = parse_facets_body(body, body)
-            if r.obj_idx[i] >= 0:
-                ordered_edges.append(
-                    Edge(pred=preds[pi], src=int(src_all[i]),
-                         dst=int(obj_uid[r.obj_idx[i]]), facets=facets))
-                continue
-            body = buf[r.lit_s[i] : r.lit_e[i]].decode("utf-8")
-            if flags[i] & F_LIT_ESCAPED:
-                body = _unescape(body)
-            tname = types[r.type_idx[i]] if flags[i] & F_HAS_TYPE else ""
-            val = typed_literal(body, tname)
-            tid = schema_tid.setdefault(pi, store.schema.type_of(preds[pi]))
-            if tid not in (TypeID.DEFAULT, TypeID.UID):
-                val = convert(val, tid)
-                if tid == TypeID.PASSWORD:
-                    val = TypedValue(TypeID.PASSWORD, hash_password(str(val.value)))
-            lang = langs[r.lang_idx[i]] if flags[i] & F_HAS_LANG else ""
-            ordered_edges.append(Edge(pred=preds[pi], src=int(src_all[i]),
-                                      value=val, lang=lang, facets=facets))
         # one batched apply: a single WAL flush standalone, one proposal
         # batch per group under replication
         if ordered_edges:

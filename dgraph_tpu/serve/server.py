@@ -278,6 +278,24 @@ def _make_handler(srv: DgraphServer):
             else:
                 self._err(404, "no such endpoint")
 
+        def _cluster_authorized(self) -> bool:
+            """Gate for the intra-cluster control plane (/raft*, /assign-uids):
+            when the cluster is configured with a shared secret, every peer
+            request must carry it — these endpoints share the public port
+            (the reference isolates its raft plane on an internal gRPC
+            port), and an unauthenticated one lets anyone with network
+            reach inject forged raft frames or arbitrary proposals."""
+            secret = getattr(srv.cluster.auth, "secret", "") if srv.cluster else ""
+            if not secret:
+                return True
+            import hmac
+
+            from dgraph_tpu.cluster.transport import SECRET_HEADER
+
+            got = self.headers.get(SECRET_HEADER, "")
+            # bytes, not str: compare_digest raises on non-ASCII strings
+            return hmac.compare_digest(got.encode("utf-8"), secret.encode("utf-8"))
+
         def do_POST(self):
             u = urlparse(self.path)
             n = int(self.headers.get("Content-Length", 0))
@@ -286,6 +304,8 @@ def _make_handler(srv: DgraphServer):
                 raw = self.rfile.read(n)
                 if srv.cluster is None:
                     return self._err(404, "not clustered")
+                if not self._cluster_authorized():
+                    return self._err(403, "bad cluster secret")
                 from dgraph_tpu.cluster.raft import NotLeaderError
 
                 try:
@@ -307,6 +327,8 @@ def _make_handler(srv: DgraphServer):
                 raw = self.rfile.read(n)
                 if srv.cluster is None:
                     return self._err(404, "not clustered")
+                if not self._cluster_authorized():
+                    return self._err(403, "bad cluster secret")
                 try:
                     gid = int(u.path.rsplit("/", 1)[1])
                 except ValueError:

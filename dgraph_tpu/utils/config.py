@@ -28,6 +28,12 @@ class Options:
     peer: str = ""
     my_addr: str = ""
     workers: int = 4
+    # cluster security: shared secret gating the raft/propose/assign
+    # endpoints, and the trust model for intra-cluster TLS (pin a CA, or
+    # explicitly opt out of verification for throwaway self-signed certs)
+    cluster_secret: str = ""
+    peer_ca: str = ""
+    peer_tls_insecure: bool = False
     # observability
     trace_ratio: float = 0.0
     expose_trace: bool = False

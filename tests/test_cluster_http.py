@@ -32,23 +32,23 @@ def _wait(cond, timeout=10.0, step=0.05):
     return False
 
 
-@pytest.fixture()
-def cluster3(tmp_path):
-    # reserve three ports
+def _boot_cluster(tmp_path, n=3, **svc_kwargs):
+    """Boot n DgraphServer+ClusterService nodes on fresh ports; returns
+    the server list.  Caller stops them (or uses the cluster3 fixture)."""
     import socket
 
     socks = []
     ports = []
-    for _ in range(3):
+    for _ in range(n):
         s = socket.socket()
         s.bind(("127.0.0.1", 0))
         ports.append(s.getsockname()[1])
         socks.append(s)
     for s in socks:
         s.close()
-    peers = {str(i + 1): f"http://127.0.0.1:{ports[i]}" for i in range(3)}
+    peers = {str(i + 1): f"http://127.0.0.1:{ports[i]}" for i in range(n)}
     servers = []
-    for i in range(3):
+    for i in range(n):
         nid = str(i + 1)
         svc = ClusterService(
             node_id=nid,
@@ -56,6 +56,7 @@ def cluster3(tmp_path):
             peers=peers,
             group_ids=[0, 1],
             directory=str(tmp_path / f"n{nid}"),
+            **svc_kwargs,
         )
         svc.start()
         srv = DgraphServer(svc.store, port=ports[i], cluster=svc)
@@ -64,9 +65,39 @@ def cluster3(tmp_path):
     assert _wait(lambda: all(s.cluster.has_leader() for s in servers)), (
         "no leader elected"
     )
+    return servers
+
+
+@pytest.fixture()
+def cluster3(tmp_path):
+    servers = _boot_cluster(tmp_path)
     yield servers
     for s in servers:
         s.stop()
+
+
+def test_cluster_secret_gates_raft_plane(tmp_path):
+    """With a shared secret configured, peer traffic (carrying the header)
+    replicates normally while unauthenticated POSTs to /raft*, /assign-uids
+    are rejected with 403 — the control plane shares the public port, so
+    the secret is what stops forged raft frames (serve/server.py gate)."""
+    servers = _boot_cluster(tmp_path, secret="s3kr1t")
+    try:
+        out = _post(servers[1].addr, "/query",
+                    'mutation { set { <0x1> <name> "sec" . } }')
+        assert out.get("code") == "Success"
+        # forged frames without the secret must bounce on every endpoint
+        for path in ("/raft/0", "/raft-propose/0", "/assign-uids"):
+            req = urllib.request.Request(
+                servers[0].addr + path, data=b"\x00garbage")
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                raise AssertionError(f"{path} accepted an unauthenticated POST")
+            except urllib.error.HTTPError as e:
+                assert e.code == 403, f"{path}: expected 403, got {e.code}"
+    finally:
+        for s in servers:
+            s.stop()
 
 
 def test_replicated_write_read_everywhere(cluster3):
@@ -156,6 +187,36 @@ def test_leader_failover(cluster3):
         for o in _post(survivors[1].addr, "/query",
                        '{ q(func: has(kind)) { kind } }').get("q", [])
     ))
+
+
+def test_schema_then_set_via_follower_converts_with_new_schema(cluster3):
+    """A schema change and a set block in ONE request through a FOLLOWER:
+    the set must convert values against the NEW schema, i.e. apply_schema
+    must wait for the forwarded proposal to apply locally before the
+    mutation path runs (the reference serializes these through the same
+    raft apply path, worker/mutation.go runSchemaMutations)."""
+    from dgraph_tpu.cluster.service import METADATA_GROUP
+
+    follower = next(
+        s for s in cluster3 if not s.cluster.groups[METADATA_GROUP].node.is_leader
+    )
+    out = _post(follower.addr, "/query", """
+    mutation {
+      schema { age: int @index(int) . }
+      set { <0x9> <age> "41" . }
+    }""")
+    assert out.get("code") == "Success"
+    # the value must be an INT everywhere — an int-indexed eq() only
+    # matches if conversion used the new schema, and the JSON value must
+    # be numeric, not the string "41"
+    def typed_everywhere():
+        for s in cluster3:
+            got = _post(s.addr, "/query", "{ q(func: eq(age, 41)) { age } }")
+            if got.get("q") != [{"age": 41}]:
+                return False
+        return True
+
+    assert _wait(typed_everywhere), "set converted against stale schema"
 
 
 def test_explicit_uid_reservation_reaches_leader(cluster3):

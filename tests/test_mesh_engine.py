@@ -45,3 +45,30 @@ def test_mesh_engine_matches_single_device():
         assert a == b, f"mesh result diverged for {q}"
     # sanity: the mesh path actually ran (sharded cache populated)
     assert meshed.arenas._sharded, "sharded arenas never built"
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_mesh_steps_compile_once():
+    """A second identical mesh query must hit the memoized compiled step
+    (zero recompiles): jit caches on function identity, so the builders
+    must return the SAME callable for the same (mesh, cap), and an
+    identical query must not lower a new executable."""
+    from dgraph_tpu.parallel import mesh as meshmod
+
+    mesh = make_mesh(8, data=2)
+    assert meshmod.seg_expand_step(mesh, 1024) is meshmod.seg_expand_step(mesh, 1024)
+    assert meshmod.sharded_expand_step(mesh, 1024) is meshmod.sharded_expand_step(
+        mesh, 1024
+    )
+
+    eng = QueryEngine(PostingStore(), mesh=mesh, shard_threshold=1)
+    _populate(eng, n=64)
+    q = QUERIES[0]
+    first = eng.run(q)
+
+    import jax._src.test_util as jtu
+
+    with jtu.count_jit_compilation_cache_miss() as misses:
+        second = eng.run(q)
+    assert second == first
+    assert misses() == 0, f"identical mesh query recompiled {misses()} step(s)"

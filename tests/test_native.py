@@ -107,6 +107,14 @@ def test_native_rejects_what_python_rejects(monkeypatch):
     # '*' is delete-only; in a set block both paths must reject it
     with pytest.raises((ParseError, ValueError)):
         apply_mutation(st, Mutation(set_nquads="<0x1> * * ."))
+    # the grammar requires \s+ BETWEEN terms and [^\S\n]+ before a label:
+    # whether a g++ toolchain was present must not decide acceptance
+    with pytest.raises(ParseError):
+        apply_mutation(st, Mutation(set_nquads="<0x1><p> <0x2> ."))
+    with pytest.raises(ParseError):
+        apply_mutation(st, Mutation(set_nquads="<0x1> <p><0x2> ."))
+    with pytest.raises(ParseError):
+        apply_mutation(st, Mutation(set_nquads='<0x1> <p> "v"<g> .'))
 
 
 def test_bulk_edges_wal_roundtrip(tmp_path):
@@ -140,6 +148,29 @@ def test_value_order_preserved_across_facet_quads(monkeypatch):
         st = PostingStore()
         apply_mutation(st, Mutation(set_nquads=body))
         assert st.value("name", 1).value == "new", f"no_native={no_native}"
+        nat._lib = None
+        nat._tried = False
+
+
+def test_bad_value_in_set_applies_no_edges(monkeypatch):
+    """All-or-nothing within one set block: a schema type-conversion
+    error on a LATER value quad must fail the request before the fast
+    path durably applies EARLIER uid edges (both paths must agree)."""
+    body = '<0x1> <link> <0x2> .\n<0x1> <age> "notanint" .'
+    for no_native in (False, True):
+        import dgraph_tpu.native as nat
+
+        if no_native:
+            monkeypatch.setenv("DGRAPH_TPU_NO_NATIVE", "1")
+        else:
+            monkeypatch.delenv("DGRAPH_TPU_NO_NATIVE", raising=False)
+        nat._lib = None
+        nat._tried = False
+        st = PostingStore()
+        st.apply_schema("age: int .\nlink: uid .")
+        with pytest.raises(Exception):
+            apply_mutation(st, Mutation(set_nquads=body))
+        assert st.neighbors("link", 1) == [], f"no_native={no_native}"
         nat._lib = None
         nat._tried = False
 
