@@ -176,9 +176,16 @@ class ValueArena:
 
     src: jnp.ndarray                # int32[Sb] sorted uids, SENT-padded
     vals: jnp.ndarray               # float32[Sb]; padding slots hold NaN
+    ranks: jnp.ndarray              # int32[Sb] dense rank of the EXACT
+                                    # float64 value (device ordering by
+                                    # rank is exact; float32 vals are not);
+                                    # padding slots hold -1
     h_src: np.ndarray               # int64[S]
     h_vals: np.ndarray              # float64[S]
     n: int
+    langless: bool = True           # no lang-tagged values existed for the
+                                    # predicate — untagged host lookup and
+                                    # this arena agree uid-for-uid
 
 
 class ArenaManager:
@@ -345,11 +352,14 @@ class ArenaManager:
         if a is None:
             pd = self.store.peek(pred)
             pairs: Dict[int, float] = {}
+            langless = True
             if pd is not None:
                 # Deterministic lang choice: untagged value wins, else the
                 # lexicographically first language (stable across ingest
                 # order, unlike dict iteration).
                 for (uid, lang) in sorted(pd.values.keys(), key=lambda k: (k[0], k[1] != "", k[1])):
+                    if lang:
+                        langless = False
                     if uid in pairs:
                         continue
                     x = numeric(pd.values[(uid, lang)])
@@ -363,12 +373,19 @@ class ArenaManager:
             su[:S] = uids.astype(np.int32)
             vv = np.full(Sb, np.nan, dtype=np.float32)
             vv[:S] = vals.astype(np.float32)
+            # dense rank of the exact float64 value: device order-by sorts
+            # by rank, immune to float32 rounding collisions
+            rk = np.full(Sb, -1, dtype=np.int32)
+            if S:
+                rk[:S] = np.searchsorted(np.unique(vals), vals).astype(np.int32)
             a = ValueArena(
                 src=jnp.asarray(su),
                 vals=jnp.asarray(vv),
+                ranks=jnp.asarray(rk),
                 h_src=uids,
                 h_vals=vals,
                 n=S,
+                langless=langless,
             )
             self._values[pred] = a
         return a

@@ -29,4 +29,8 @@ from dgraph_tpu.ops.sets import (  # noqa: F401
     unique_rows_sorted,
     frontier_rows,
 )
+from dgraph_tpu.ops.order import (  # noqa: F401
+    gather_ranks,
+    segmented_sort_perm,
+)
 from dgraph_tpu.ops import ref  # noqa: F401

@@ -1,0 +1,63 @@
+"""Device-side segmented order-by kernels.
+
+TPU-native equivalent of the reference's distributed sort (worker/sort.go
+processSort:263 / sortWithoutIndex:123 → types.Sort, types/sort.go:92):
+instead of fetching values per uid and sorting each uid_matrix row on the
+host, the engine gathers *value ranks* from the predicate's ValueArena in
+one vectorized binary search and orders the whole flattened uid_matrix
+with a single stable lexsort keyed on (segment, ±rank).
+
+Ranks, not raw floats: the ValueArena stores each value's dense rank in
+the sorted order of exact float64 values, so device ordering is exact —
+float32 rounding on the vals tensor can never swap two close keys.  Ties
+(equal values) keep their input order because lexsort is stable, matching
+the host path's stable ``sorted``.  Missing values (uid has no value for
+the predicate) sort last ascending and first descending, exactly like the
+host key ``(9,)`` under ``reverse=``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.ops.sets import SENT
+
+# larger than any rank or segment index; used to push padding to the tail
+_BIG = jnp.int32(1 << 30)
+
+
+@jax.jit
+def gather_ranks(src: jnp.ndarray, ranks: jnp.ndarray, uids: jnp.ndarray) -> jnp.ndarray:
+    """Map uids → value ranks via the ValueArena's sorted src column.
+
+    Returns int32[B]; -1 where the uid has no value (or is padding).
+    One vectorized binary search — the batched analog of the per-uid
+    ``ValueFor`` fetches in sortWithoutIndex (worker/sort.go:123-149).
+    """
+    pos = jnp.clip(jnp.searchsorted(src, uids), 0, src.shape[0] - 1)
+    hit = (src[pos] == uids) & (uids != SENT)
+    return jnp.where(hit, ranks[pos], jnp.int32(-1))
+
+
+@partial(jax.jit, static_argnames=("desc",))
+def segmented_sort_perm(seg: jnp.ndarray, ranks: jnp.ndarray, desc: bool) -> jnp.ndarray:
+    """Stable permutation ordering each segment by value rank.
+
+    Args:
+      seg:   int32[cap] segment id per slot; -1 = padding (sorts to tail).
+      ranks: int32[cap] value rank per slot; -1 = missing value.
+      desc:  descending order within each segment.
+
+    Returns int32[cap] permutation p such that x[p] is grouped by segment
+    (ascending), each segment ordered by rank (±), missing values last
+    (ascending) / first (descending), ties in input order.
+    """
+    if desc:
+        key = jnp.where(ranks < 0, -_BIG, -ranks)
+    else:
+        key = jnp.where(ranks < 0, _BIG, ranks)
+    segk = jnp.where(seg < 0, _BIG, seg)
+    return jnp.lexsort((key, segk)).astype(jnp.int32)

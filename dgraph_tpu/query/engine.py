@@ -12,7 +12,6 @@ reference draws at the ServeTask boundary (SURVEY.md §2c).
 
 from __future__ import annotations
 
-import math as pymath
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -231,13 +230,16 @@ class QueryEngine:
             return
         if attr == "_predicate_":
             child.src_uids = src
+            # inverted: uids_with_data is built ONCE per predicate and
+            # probed per uid (was rebuilt per uid × per predicate)
+            names: Dict[int, List[str]] = {int(u): [] for u in src.tolist()}
+            for pr in self.store.predicates():
+                with_data = self.store.pred(pr).uids_with_data()
+                for u in names:
+                    if u in with_data:
+                        names[u].append(pr)
             child.values = {
-                int(u): TypedValue(
-                    TypeID.STRING,
-                    [pr for pr in self.store.predicates()
-                     if int(u) in self.store.pred(pr).uids_with_data()],
-                )
-                for u in src.tolist()
+                u: TypedValue(TypeID.STRING, ps) for u, ps in names.items()
             }
             return
         if child.func is not None and child.func.name == "checkpwd":
@@ -262,20 +264,31 @@ class QueryEngine:
             return
 
         if not is_uid_pred:
-            # value leaf: fetch typed values for each src uid
+            # value leaf: fetch typed values for each src uid — direct
+            # dict probes on the predicate's value map (store.value call
+            # overhead removed from the hot loop; lang fallback semantics
+            # identical: each tagged lookup falls back to untagged)
             child.src_uids = src
             langs = child.langs or [""]
             vals = {}
-            for u in src.tolist():
-                v = None
-                for l in langs:
-                    v = self.store.value(attr, int(u), l)
-                    if v is not None:
-                        break
-                if v is not None:
-                    vals[int(u)] = v
-            child.values = vals
             pd = self.store.peek(attr)
+            if pd is not None:
+                pv = pd.values
+                if langs == [""]:
+                    for u in src.tolist():
+                        tv = pv.get((u, ""))
+                        if tv is not None:
+                            vals[u] = tv
+                else:
+                    for u in src.tolist():
+                        for l in langs:
+                            tv = pv.get((u, l))
+                            if tv is None and l:
+                                tv = pv.get((u, ""))
+                            if tv is not None:
+                                vals[u] = tv
+                                break
+            child.values = vals
             if pd is not None and pd.value_facets and child.params.facets:
                 child.value_facets = {
                     int(u): pd.value_facets[int(u)]
@@ -376,27 +389,37 @@ class QueryEngine:
             return
         counts = np.diff(sg.seg_ptr)
         owner = np.repeat(np.arange(len(counts)), counts)
-        for j, dst in enumerate(sg.out_flat.tolist()):
-            src = int(sg.src_uids[owner[j]])
-            key = (dst, src) if sg.reverse else (src, int(dst))
-            f = pd.edge_facets.get(key)
+        srcs = sg.src_uids[owner].tolist()  # vectorized gather, then probe
+        ef = pd.edge_facets
+        for src, dst in zip(srcs, sg.out_flat.tolist()):
+            f = ef.get((dst, src) if sg.reverse else (src, dst))
             if f:
-                sg.edge_facets[(src, int(dst))] = f
+                sg.edge_facets[(src, dst)] = f
 
     def _apply_facet_filter(self, sg: SubGraph):
         """@facets(eq(key, val)): keep edges whose facets satisfy the tree."""
         tree = sg.params.facets_filter
+        from dgraph_tpu.models.types import compare_vals, convert
+
+        # conversion memo: the filter's string arg converts to the same
+        # target once per (func, facet-tid), not once per edge
+        conv_memo: Dict[tuple, Optional[TypedValue]] = {}
 
         def ok(facets: Dict[str, TypedValue], ft: FilterTree) -> bool:
             if ft.func is not None:
                 fv = facets.get(ft.func.attr)
                 if fv is None:
                     return False
-                from dgraph_tpu.models.types import compare_vals, convert
-
-                try:
-                    target = convert(TypedValue(TypeID.STRING, ft.func.args[0]), fv.tid)
-                except (ValueError, IndexError):
+                mk = (id(ft.func), fv.tid)
+                if mk not in conv_memo:
+                    try:
+                        conv_memo[mk] = convert(
+                            TypedValue(TypeID.STRING, ft.func.args[0]), fv.tid
+                        )
+                    except (ValueError, IndexError):
+                        conv_memo[mk] = None
+                target = conv_memo[mk]
+                if target is None:
                     return False
                 try:
                     return compare_vals(ft.func.name, fv, target)
@@ -412,10 +435,14 @@ class QueryEngine:
 
         counts = np.diff(sg.seg_ptr)
         owner = np.repeat(np.arange(len(counts)), counts)
-        mask = np.zeros(len(sg.out_flat), dtype=bool)
-        for j, dst in enumerate(sg.out_flat.tolist()):
-            src = int(sg.src_uids[owner[j]])
-            mask[j] = ok(sg.edge_facets.get((src, int(dst)), {}), tree)
+        srcs = sg.src_uids[owner].tolist()
+        ef = sg.edge_facets
+        mask = np.fromiter(
+            (ok(ef.get((s, d), {}), tree)
+             for s, d in zip(srcs, sg.out_flat.tolist())),
+            dtype=bool,
+            count=len(sg.out_flat),
+        )
         _apply_edge_mask(sg, mask)
 
     # -- order & pagination --------------------------------------------------
@@ -440,14 +467,76 @@ class QueryEngine:
 
         return key
 
+    # device order-by eligibility: types whose host sort_key orders
+    # identically to the ValueArena's exact-float64 value ranks
+    _DEVICE_ORDER_TIDS = (
+        TypeID.INT, TypeID.FLOAT, TypeID.DATETIME, TypeID.DATE, TypeID.BOOL,
+    )
+
+    def _device_order_perm(
+        self, out: np.ndarray, owner: np.ndarray, attr: str, desc: bool
+    ) -> Optional[np.ndarray]:
+        """Segmented order-by on device (the TPU replacement for the
+        reference's per-row types.Sort, worker/sort.go:123-149 + SURVEY
+        §7.6 "segmented top-k"): gather value RANKS from the ValueArena
+        with one batched binary search, then one stable lexsort over
+        (segment, ±rank).  Returns the permutation, or None when the host
+        path must handle it (string keys, lang-tagged values, value vars)."""
+        tid = self.store.schema.type_of(attr)
+        if tid not in self._DEVICE_ORDER_TIDS:
+            return None
+        va = self.arenas.values(attr)
+        if not va.langless:
+            return None
+        n = len(out)
+        if n == 0:
+            return np.empty(0, dtype=np.int64)
+        import jax.numpy as jnp
+
+        cap = ops.bucket(n)
+        uids_pad = jnp.asarray(ops.pad_to(out, cap))
+        seg_pad = np.full(cap, -1, dtype=np.int32)
+        seg_pad[:n] = owner
+        ranks = ops.gather_ranks(va.src, va.ranks, uids_pad)
+        perm = np.asarray(
+            ops.segmented_sort_perm(jnp.asarray(seg_pad), ranks, bool(desc))
+        )
+        return perm[:n].astype(np.int64)  # padding sorts to the tail
+
+    def _host_order_perm(
+        self, out: np.ndarray, owner: np.ndarray, n_segs: int, key, desc: bool
+    ) -> np.ndarray:
+        """Per-segment stable python sort (string keys / vars / lang
+        fallback).  Returns a permutation of range(len(out))."""
+        perm = np.arange(len(out), dtype=np.int64)
+        starts = np.zeros(n_segs + 1, dtype=np.int64)
+        np.cumsum(np.bincount(owner, minlength=n_segs), out=starts[1:])
+        for i in range(n_segs):
+            lo, hi = int(starts[i]), int(starts[i + 1])
+            if hi - lo > 1:
+                seg_idx = sorted(
+                    range(lo, hi), key=lambda j: key(int(out[j])), reverse=desc
+                )
+                perm[lo:hi] = seg_idx
+        return perm
+
     def _order_and_paginate_root(self, sg: SubGraph, dest: np.ndarray, value_vars) -> np.ndarray:
         p = sg.params
         if p.after:
             dest = dest[dest > p.after]
         if p.order_attr:
-            key = self._value_key_fn(p.order_attr, p.order_langs, value_vars, p.order_is_var)
-            lst = sorted(dest.tolist(), key=key, reverse=p.order_desc)
-            dest = np.array(lst, dtype=np.int64)
+            perm = None
+            if not (p.order_is_var or p.order_langs):
+                perm = self._device_order_perm(
+                    dest, np.zeros(len(dest), dtype=np.int64), p.order_attr,
+                    p.order_desc,
+                )
+            if perm is not None:
+                dest = dest[perm]
+            else:
+                key = self._value_key_fn(p.order_attr, p.order_langs, value_vars, p.order_is_var)
+                lst = sorted(dest.tolist(), key=key, reverse=p.order_desc)
+                dest = np.array(lst, dtype=np.int64)
         dest = _paginate(dest, p.offset, p.first)
         return dest
 
@@ -456,42 +545,50 @@ class QueryEngine:
         if not (p.first or p.offset or p.after or p.order_attr or
                 (p.facets and p.facets.order_key)):
             return
-        key = None
-        if p.order_attr:
-            key = self._value_key_fn(p.order_attr, p.order_langs, value_vars, p.order_is_var)
         counts = np.diff(sg.seg_ptr)
-        rows: List[np.ndarray] = []
-        pos = 0
-        for i, c in enumerate(counts):
-            row = sg.out_flat[pos : pos + c]
-            pos += c
-            if p.after:
-                row = row[row > p.after]
-            if p.facets and p.facets.order_key:
-                src = int(sg.src_uids[i])
-                fkey = p.facets.order_key
+        n_segs = len(counts)
+        out = sg.out_flat
+        owner = np.repeat(np.arange(n_segs), counts)
 
-                def fk(u: int):
-                    f = sg.edge_facets.get((src, int(u)), {})
-                    v = f.get(fkey)
-                    return sort_key(v) if v is not None else (9,)
+        # -- ordering (commutes with the 'after' uid filter) ----------------
+        if p.facets and p.facets.order_key:
+            fkey_name = p.facets.order_key
 
-                row = np.array(
-                    sorted(row.tolist(), key=fk, reverse=p.facets.order_desc),
-                    dtype=np.int64,
+            def fkey_at(j: int):
+                src = int(sg.src_uids[owner[j]])
+                f = sg.edge_facets.get((src, int(out[j])), {})
+                v = f.get(fkey_name)
+                return sort_key(v) if v is not None else (9,)
+
+            perm = np.arange(len(out), dtype=np.int64)
+            starts = np.zeros(n_segs + 1, dtype=np.int64)
+            np.cumsum(counts, out=starts[1:])
+            for i in range(n_segs):
+                lo, hi = int(starts[i]), int(starts[i + 1])
+                if hi - lo > 1:
+                    perm[lo:hi] = sorted(
+                        range(lo, hi), key=fkey_at, reverse=p.facets.order_desc
+                    )
+            out, owner = out[perm], owner[perm]
+        elif p.order_attr:
+            perm = None
+            if not (p.order_is_var or p.order_langs):
+                perm = self._device_order_perm(out, owner, p.order_attr, p.order_desc)
+            if perm is None:
+                key = self._value_key_fn(
+                    p.order_attr, p.order_langs, value_vars, p.order_is_var
                 )
-            elif key is not None:
-                row = np.array(
-                    sorted(row.tolist(), key=key, reverse=p.order_desc),
-                    dtype=np.int64,
-                )
-            row = _paginate(row, p.offset, p.first)
-            rows.append(row)
-        sg.out_flat = (
-            np.concatenate(rows) if rows else _EMPTY
-        )
-        sg.seg_ptr = np.zeros(len(counts) + 1, dtype=np.int64)
-        np.cumsum([len(r) for r in rows], out=sg.seg_ptr[1:])
+                perm = self._host_order_perm(out, owner, n_segs, key, p.order_desc)
+            out, owner = out[perm], owner[perm]
+
+        # -- after + per-segment windowing (vectorized, no python loop) -----
+        if p.after:
+            m = out > p.after
+            out, owner = out[m], owner[m]
+        out, owner = _window_segments(out, owner, n_segs, p.offset, p.first)
+        sg.out_flat = out
+        sg.seg_ptr = np.zeros(n_segs + 1, dtype=np.int64)
+        np.cumsum(np.bincount(owner, minlength=n_segs), out=sg.seg_ptr[1:])
 
     # -- vars / aggregation / math -------------------------------------------
 
@@ -528,20 +625,25 @@ class QueryEngine:
             value_vars[child.params.var] = dict(child.values)
 
     def _eval_math(self, mt: MathTree, src: np.ndarray, value_vars) -> Dict[int, TypedValue]:
-        """Evaluate math() per uid over the value-variable environment
-        (query/math.go evalMathTree)."""
+        """Evaluate math() over the value-variable environment
+        (query/math.go evalMathTree) — vectorized: the whole expression
+        tree runs elementwise over one uid-aligned float64 array instead
+        of a python interpreter loop per uid.  Error semantics match the
+        per-uid path: a uid is dropped when a variable is missing or the
+        arithmetic is undefined there (div-zero/log-domain/overflow all
+        surface as non-finite lanes)."""
         uids = set()
         self._math_uids(mt, value_vars, uids)
         if not uids:
             uids = {int(u) for u in src.tolist()}
-        out = {}
-        for u in sorted(uids):
-            try:
-                val = _eval_math_at(mt, u, value_vars)
-            except (KeyError, ZeroDivisionError, ValueError, OverflowError):
-                continue
-            out[u] = TypedValue(TypeID.FLOAT, float(val))
-        return out
+        ua = np.array(sorted(uids), dtype=np.int64)
+        with np.errstate(all="ignore"):
+            vals, ok = _eval_math_vec(mt, ua, value_vars)
+            ok = ok & np.isfinite(vals)
+        return {
+            int(u): TypedValue(TypeID.FLOAT, float(v))
+            for u, v in zip(ua[ok].tolist(), vals[ok].tolist())
+        }
 
     def _math_uids(self, mt: MathTree, value_vars, acc: set):
         if mt.var and mt.var in value_vars:
@@ -587,6 +689,31 @@ def _apply_edge_mask(sg: SubGraph, mask: np.ndarray) -> None:
     np.cumsum(kept, out=sg.seg_ptr[1:])
 
 
+def _window_segments(
+    out: np.ndarray, owner: np.ndarray, n_segs: int, offset: int, first: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Apply _paginate's offset/first window to every segment at once:
+    position-within-segment is computed vectorized, so pagination costs
+    O(edges) numpy work regardless of segment count."""
+    if not (offset or first) or len(out) == 0:
+        return out, owner
+    offset = max(offset, 0)  # _paginate ignores non-positive offsets
+    counts = np.bincount(owner, minlength=n_segs)
+    starts = np.zeros(n_segs + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    pos = np.arange(len(out), dtype=np.int64) - starts[owner]
+    keep = np.ones(len(out), dtype=bool)
+    if offset > 0:
+        keep &= pos >= offset
+    if first > 0:
+        keep &= pos < offset + first
+    elif first < 0:
+        # negative first = last |first| entries of the post-offset slice
+        eff = np.maximum(counts[owner] - max(offset, 0), 0)
+        keep &= pos >= max(offset, 0) + np.maximum(eff + first, 0)
+    return out[keep], owner[keep]
+
+
 def _paginate(arr: np.ndarray, offset: int, first: int) -> np.ndarray:
     """first/offset windowing (x.PageRange analog: negative first = from
     the end)."""
@@ -605,53 +732,69 @@ _MATH_BIN = {
     "-": lambda a, b: a - b,
     "*": lambda a, b: a * b,
     "/": lambda a, b: a / b,
-    "%": lambda a, b: pymath.fmod(a, b),
-    "<": lambda a, b: a < b,
-    ">": lambda a, b: a > b,
-    "<=": lambda a, b: a <= b,
-    ">=": lambda a, b: a >= b,
-    "==": lambda a, b: a == b,
-    "!=": lambda a, b: a != b,
-    "pow": lambda a, b: a ** b,
-    "logbase": lambda a, b: pymath.log(a, b),
+    "%": np.fmod,
+    "<": lambda a, b: (a < b).astype(np.float64),
+    ">": lambda a, b: (a > b).astype(np.float64),
+    "<=": lambda a, b: (a <= b).astype(np.float64),
+    ">=": lambda a, b: (a >= b).astype(np.float64),
+    "==": lambda a, b: (a == b).astype(np.float64),
+    "!=": lambda a, b: (a != b).astype(np.float64),
+    "pow": lambda a, b: np.power(a, b),
+    "logbase": lambda a, b: np.log(a) / np.log(b),
+}
+
+_MATH_UNARY = {
+    "u-": np.negative,
+    "exp": np.exp,
+    "ln": np.log,
+    "sqrt": np.sqrt,
+    "floor": np.floor,
+    "ceil": np.ceil,
 }
 
 
-def _eval_math_at(mt: MathTree, uid: int, value_vars) -> float:
+def _eval_math_vec(mt: MathTree, ua: np.ndarray, value_vars):
+    """Elementwise tree evaluation over uid-aligned arrays.  Returns
+    (float64[n] values, bool[n] defined-mask); undefined lanes carry NaN.
+    Boolean results are 1.0/0.0 (the per-uid path's float(bool))."""
+    n = len(ua)
     if mt.var:
-        v = value_vars.get(mt.var, {}).get(uid)
-        if v is None:
-            raise KeyError(mt.var)
-        x = numeric(v)
-        if x is None:
-            raise ValueError("non-numeric value in math")
-        return x
+        vmap = value_vars.get(mt.var, {})
+        vals = np.full(n, np.nan, dtype=np.float64)
+        ok = np.zeros(n, dtype=bool)
+        for i, u in enumerate(ua.tolist()):
+            tv = vmap.get(u)
+            if tv is None:
+                continue
+            x = numeric(tv)
+            if x is not None:
+                vals[i] = x
+                ok[i] = True
+        return vals, ok
     if mt.const is not None:
-        return mt.const
+        return (
+            np.full(n, float(mt.const), dtype=np.float64),
+            np.ones(n, dtype=bool),
+        )
     fn = mt.fn
-    kids = [_eval_math_at(c, uid, value_vars) for c in mt.children]
-    if fn in _MATH_BIN and len(kids) == 2:
-        return _MATH_BIN[fn](kids[0], kids[1])
-    if fn == "u-":
-        return -kids[0]
-    if fn == "exp":
-        return pymath.exp(kids[0])
-    if fn == "ln":
-        return pymath.log(kids[0])
-    if fn == "sqrt":
-        return pymath.sqrt(kids[0])
-    if fn == "floor":
-        return pymath.floor(kids[0])
-    if fn == "ceil":
-        return pymath.ceil(kids[0])
+    kid_vals = []
+    ok = np.ones(n, dtype=bool)
+    for c in mt.children:
+        v, o = _eval_math_vec(c, ua, value_vars)
+        kid_vals.append(v)
+        ok &= o
+    if fn in _MATH_BIN and len(kid_vals) == 2:
+        return _MATH_BIN[fn](kid_vals[0], kid_vals[1]), ok
+    if fn in _MATH_UNARY and len(kid_vals) == 1:
+        return _MATH_UNARY[fn](kid_vals[0]), ok
     if fn == "since":
         import time
 
-        return time.time() - kids[0]
+        return time.time() - kid_vals[0], ok
     if fn == "max":
-        return max(kids)
+        return np.maximum.reduce(kid_vals), ok
     if fn == "min":
-        return min(kids)
+        return np.minimum.reduce(kid_vals), ok
     if fn == "cond":
-        return kids[1] if kids[0] else kids[2]
-    raise ValueError(f"unknown math fn {fn!r}")
+        return np.where(kid_vals[0] != 0, kid_vals[1], kid_vals[2]), ok
+    raise QueryError(f"unknown math fn {fn!r}")
