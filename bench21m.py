@@ -1,0 +1,166 @@
+"""21M-quad scale proof: load a Freebase-film-shaped synthetic graph at
+the reference's anchor scale through the real mutation path (native
+scanner + vectorized bulk apply), then run the two wiki query shapes.
+
+Reference anchors (BASELINE.md): 21M RDF loaded in ~5min (≈73k quads/s,
+i7 laptop); 3-hop co-actor query 2-3ms warm / 8-9ms cold; 4-level detail
+query 30-35ms warm / 87ms cold; 1.4GB on disk.
+
+Usage: python bench21m.py    (env: B21_QUADS target, default 21_000_000;
+B21_CHUNK quads per mutation, default 2_000_000)
+Prints one JSON line per metric.  Peak RSS is sampled via resource.
+"""
+
+import json
+import os
+import resource
+import time
+
+from bench_engine import SCHEMA, build
+from dgraph_tpu.models import PostingStore
+from dgraph_tpu.query import QueryEngine
+
+# quads per director in bench_engine.build (1 dir name + 8 films ×
+# (name + date + director.film + genre + 6 × (perf.actor + starring)))
+QUADS_PER_DIRECTOR = 1 + 8 * (4 + 6 * 2)
+
+
+def rss_gb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+
+
+def main():
+    target = int(os.environ.get("B21_QUADS", 21_000_000))
+    chunk_quads = int(os.environ.get("B21_CHUNK", 2_000_000))
+    n_directors = target // QUADS_PER_DIRECTOR
+    per_chunk = max(1, chunk_quads // QUADS_PER_DIRECTOR)
+
+    st = PostingStore()
+    eng = QueryEngine(st)
+    eng.run("mutation { schema { %s } }" % SCHEMA)
+
+    total_quads = 0
+    gen_s = 0.0
+    load_s = 0.0
+    done = 0
+    while done < n_directors:
+        n = min(per_chunk, n_directors - done)
+        t0 = time.time()
+        # each chunk gets its own uid space via seed offsetting: build()
+        # numbers uids from 1, so rebase by string replace would be
+        # wrong — instead generate with disjoint uid bases
+        rdf = build_chunk(done, n)
+        gen_s += time.time() - t0
+        t0 = time.time()
+        eng.run("mutation { set { %s } }" % rdf)
+        load_s += time.time() - t0
+        total_quads += rdf.count("\n") + 1
+        done += n
+        print(
+            f"# loaded {done}/{n_directors} directors, {total_quads:,} quads, "
+            f"rss {rss_gb():.1f}GB, load {load_s:.0f}s "
+            f"({total_quads / max(load_s, 1e-9):,.0f} quads/s)",
+            flush=True,
+        )
+
+    print(json.dumps({
+        "metric": "bulk_load_quads_per_sec",
+        "value": round(total_quads / load_s, 1),
+        "unit": "quads/s",
+        "vs_baseline": round((total_quads / load_s) / 73_000, 3),
+        "quads": total_quads,
+        "rss_gb": round(rss_gb(), 2),
+    }), flush=True)
+
+    # the two wiki shapes, seeded mid-graph
+    co_actor = """
+    { me(func: eq(name, "Actor 7")) {
+        ~performance.actor { ~starring {
+          name
+          starring { performance.actor { name } }
+        } }
+    } }"""
+    detail = """
+    { dir(func: eq(name, "Director 11")) {
+        name
+        director.film (orderasc: initial_release_date) {
+          name
+          initial_release_date
+          genre { name }
+          starring { performance.actor { name } }
+        }
+    } }"""
+    baselines = {"3hop_coactor": 2.5, "4level_detail": 32.5}  # warm ms, i7
+    for label, q in (("3hop_coactor", co_actor), ("4level_detail", detail)):
+        t0 = time.time()
+        out = eng.run(q)
+        cold_ms = (time.time() - t0) * 1e3
+        assert out, f"{label} empty"
+        times = []
+        for _ in range(10):
+            t0 = time.time()
+            eng.run(q)
+            times.append((time.time() - t0) * 1e3)
+        times.sort()
+        p50 = times[len(times) // 2]
+        print(json.dumps({
+            "metric": f"engine21m_{label}_warm_p50",
+            "value": round(p50, 2),
+            "unit": "ms",
+            "vs_baseline": round(baselines[label] / p50, 3),
+            "cold_ms": round(cold_ms, 1),
+        }), flush=True)
+    print(f"# final rss {rss_gb():.1f}GB", flush=True)
+
+
+def build_chunk(start_director: int, n_directors: int) -> str:
+    """Film-graph chunk with uids disjoint from other chunks.  Re-uses
+    bench_engine.build's shape but offsets every uid and entity label by
+    the chunk base so chunks interconnect only through shared actor names
+    (like separate loader batches, which share nothing but xids)."""
+    import random
+
+    rng = random.Random(1000 + start_director)
+    lines = []
+    # uid space: reserve a fixed 140-uid window per director (>= 1 dir +
+    # 8 films + 48 performances) plus a global actor/genre block at the top
+    ACTORS = 400_000
+    GENRES = 32
+    PER_DIR = 140
+    base_fixed = 1 + GENRES + ACTORS
+
+    def u(x):
+        return f"<0x{x:x}>"
+
+    if start_director == 0:
+        for gi in range(GENRES):
+            lines.append(f'{u(1 + gi)} <name> "Genre {gi}" .')
+        # actor names are written lazily by the first chunk only
+        for ai in range(ACTORS):
+            lines.append(f'{u(1 + GENRES + ai)} <name> "Actor {ai}" .')
+    for di in range(start_director, start_director + n_directors):
+        cursor = base_fixed + di * PER_DIR
+        d = cursor
+        cursor += 1
+        lines.append(f'{u(d)} <name> "Director {di}" .')
+        for fi in range(8):
+            f = cursor
+            cursor += 1
+            lines.append(f'{u(f)} <name> "Film {di}-{fi}" .')
+            y = 1960 + rng.randrange(60)
+            lines.append(
+                f'{u(f)} <initial_release_date> "{y}-0{1 + rng.randrange(9)}-1{rng.randrange(9)}" .'
+            )
+            lines.append(f"{u(d)} <director.film> {u(f)} .")
+            lines.append(f"{u(f)} <genre> {u(1 + rng.randrange(GENRES))} .")
+            for _ in range(6):
+                p = cursor
+                cursor += 1
+                a = 1 + GENRES + rng.randrange(ACTORS)
+                lines.append(f"{u(p)} <performance.actor> {u(a)} .")
+                lines.append(f"{u(f)} <starring> {u(p)} .")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    main()

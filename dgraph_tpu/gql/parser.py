@@ -49,7 +49,7 @@ _TOKEN_RE = re.compile(
   | (?P<dollar>\$[A-Za-z_][A-Za-z0-9_]*)
   | (?P<spread>\.\.\.)
   | (?P<op><=|>=|==|!=|&&|\|\||=|[-+*/%<>])
-  | (?P<punct>[{}()\[\]:,@!])
+  | (?P<punct>[{}()\[\]:,@!.])
     """,
     re.VERBOSE,
 )
@@ -240,6 +240,19 @@ class _Parser:
         gq.children = self._parse_children()
         return gq
 
+    def _parse_lang_chain(self) -> List[str]:
+        """The lang list after '@': ``ru:en:.`` — names separated by ':',
+        where '.' is the forced any-value fallback (gql/parser.go lang
+        list semantics, query_test.go TestLangMany*/ForcedFallback)."""
+        langs: List[str] = []
+        while True:
+            if self.accept("punct", "."):
+                langs.append(".")
+            else:
+                langs.append(self.expect("name").text)
+            if not self.accept("punct", ":"):
+                return langs
+
     def _parse_root_args(self, gq: GraphQuery):
         if not self.accept("punct", "("):
             return
@@ -327,9 +340,8 @@ class _Parser:
             self.expect("punct", ")")
         elif t.kind in ("name", "iri"):
             fn.attr = t.text.strip("<>") if t.kind == "iri" else t.text
-            while self.accept("punct", "@"):
-                lang = self.expect("name").text
-                fn.lang = lang if not fn.lang else fn.lang + "," + lang
+            if self.accept("punct", "@"):
+                fn.lang = ",".join(self._parse_lang_chain())
         else:
             raise ParseError(f"bad function first arg {t.text!r}")
         # remaining args
@@ -452,8 +464,10 @@ class _Parser:
                         continue
                     attr = self.expect("name").text
                     lang = ""
-                    while self.accept("punct", "@"):
-                        lang = self.expect("name").text
+                    if self.accept("punct", "@"):
+                        # full chain, ':'-joined (groupby.py resolves it
+                        # element by element, '.' = any_value fallback)
+                        lang = ":".join(self._parse_lang_chain())
                     gq.groupby_attrs.append((attr, lang))
             elif d == "facets":
                 self._parse_facets(gq)
@@ -580,8 +594,8 @@ class _Parser:
                 raise ParseError("count(val()) is not allowed")
             gq.attr = inner
             gq.is_count = True
-            while self.accept("punct", "@"):
-                gq.langs.append(self.expect("name").text)
+            if self.accept("punct", "@"):
+                gq.langs.extend(self._parse_lang_chain())
             self.expect("punct", ")")
         elif low in _AGG_FUNCS and self.peek().text == "(":
             self.expect("punct", "(")
@@ -628,12 +642,11 @@ class _Parser:
             gq.func = f
         else:
             gq.attr = name
-            while self.peek().kind == "punct" and self.peek().text == "@":
+            if self.peek().kind == "punct" and self.peek().text == "@":
                 nxt = self.peek(1)
-                if nxt.kind == "name" and nxt.text.lower() in _DIRECTIVES:
-                    break
-                self.next()
-                gq.langs.append(self.expect("name").text)
+                if not (nxt.kind == "name" and nxt.text.lower() in _DIRECTIVES):
+                    self.next()
+                    gq.langs.extend(self._parse_lang_chain())
 
         # (args) — pagination/order on the edge
         if self.peek().text == "(":

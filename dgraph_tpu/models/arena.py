@@ -79,6 +79,23 @@ def _build_csr(rows_to_dsts: Dict[int, np.ndarray]) -> CSRArena:
     return _csr_from_arrays(keys, offsets, dst)
 
 
+def _edges_columnar(edges: Dict[int, set]) -> Tuple[np.ndarray, np.ndarray]:
+    """Flatten a dict-of-sets edge map into parallel (src, dst) arrays in
+    ONE pass — per-row work is two C-speed slice assignments, so the
+    million-row predicates of a 21M-quad graph extract in seconds (the
+    per-row _build_csr path took a python sort per row)."""
+    n = sum(len(s) for s in edges.values())
+    src = np.empty(n, dtype=np.int64)
+    dst = np.empty(n, dtype=np.int64)
+    i = 0
+    for u, s in edges.items():
+        k = len(s)
+        src[i : i + k] = u
+        dst[i : i + k] = list(s)
+        i += k
+    return src, dst
+
+
 def _sorted_unique_edges(src: np.ndarray, dst: np.ndarray):
     """Sort edge pairs by (src, dst) and drop duplicates (vectorized)."""
     src = np.asarray(src, dtype=np.int64)
@@ -92,12 +109,22 @@ def _sorted_unique_edges(src: np.ndarray, dst: np.ndarray):
     return s, d
 
 
-def csr_from_edges(src: np.ndarray, dst: np.ndarray) -> CSRArena:
-    """Vectorized bulk CSR construction from parallel edge arrays — the
-    bulk-load path (no per-row python loops; the dict-of-sets store path
-    is for incremental mutations only)."""
+def csr_from_edges(
+    src: np.ndarray, dst: np.ndarray, row_universe: Optional[np.ndarray] = None
+) -> CSRArena:
+    """Vectorized bulk CSR construction from parallel edge arrays — no
+    per-row python loops (one global lexsort).  ``row_universe`` adds
+    degree-0 rows for uids beyond the edge sources (the has()/_predicate_
+    arena needs rows for uids that only carry values)."""
     s, d = _sorted_unique_edges(src, dst)
-    keys, counts = np.unique(s, return_counts=True)
+    ekeys, counts = np.unique(s, return_counts=True)
+    if row_universe is not None and len(row_universe):
+        keys = np.union1d(ekeys, np.asarray(row_universe, dtype=np.int64))
+        full = np.zeros(len(keys), dtype=np.int64)
+        full[np.searchsorted(keys, ekeys)] = counts
+        counts = full
+    else:
+        keys = ekeys
     offsets = np.zeros(len(keys) + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
     return _csr_from_arrays(keys, offsets, d.astype(np.int32))
@@ -262,11 +289,10 @@ class ArenaManager:
         a = self._data.get(pred)
         if a is None:
             pd = self.store.peek(pred)
-            rows: Dict[int, np.ndarray] = {}
-            if pd is not None:
-                for u, dsts in pd.edges.items():
-                    rows[u] = np.fromiter(dsts, dtype=np.int64, count=len(dsts))
-            a = _build_csr(rows)
+            if pd is not None and pd.edges:
+                a = csr_from_edges(*_edges_columnar(pd.edges))
+            else:
+                a = _build_csr({})
             self._data[pred] = a
         return a
 
@@ -282,10 +308,11 @@ class ArenaManager:
         key = pred + "\x00has"
         a = self._data.get(key)
         if a is None:
-            rows = {u: np.empty(0, dtype=np.int64) for u in pd.uids_with_data()}
-            for u, dsts in pd.edges.items():
-                rows[u] = np.fromiter(dsts, dtype=np.int64, count=len(dsts))
-            a = _build_csr(rows)
+            universe = np.fromiter(
+                pd.uids_with_data(), dtype=np.int64
+            )
+            src, dst = _edges_columnar(pd.edges)
+            a = csr_from_edges(src, dst, row_universe=universe)
             self._data[key] = a
         return a
 
@@ -294,12 +321,12 @@ class ArenaManager:
         a = self._reverse.get(pred)
         if a is None:
             pd = self.store.peek(pred)
-            rows: Dict[int, list] = {}
-            if pd is not None:
-                for u, dsts in pd.edges.items():
-                    for d in dsts:
-                        rows.setdefault(d, []).append(u)
-            a = _build_csr({k: np.asarray(v, dtype=np.int64) for k, v in rows.items()})
+            if pd is not None and pd.edges:
+                src, dst = _edges_columnar(pd.edges)
+                a = csr_from_edges(dst, src)  # inverted: one lexsort, no
+                # per-target python append loop (posting/index.go:152)
+            else:
+                a = _build_csr({})
             self._reverse[pred] = a
         return a
 

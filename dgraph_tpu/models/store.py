@@ -78,15 +78,15 @@ class PostingStore:
         return self._preds.get(name)
 
     def value(self, pred: str, uid: int, lang: str = "") -> Optional[TypedValue]:
+        """Exact-language lookup: a tagged request does NOT fall back to
+        the untagged value — matching the reference's v0.7 semantics
+        (query_test.go TestLangSingleFallback: name@cn with no @cn value
+        yields nothing).  Fallback is explicit: the '.' element of a lang
+        chain maps to any_value()."""
         p = self._preds.get(pred)
         if p is None:
             return None
-        v = p.values.get((uid, lang))
-        if v is None and lang:
-            # language fallback to the untagged value (posting/list.go:850
-            # ValueFor falls back across the lang preference list)
-            v = p.values.get((uid, ""))
-        return v
+        return p.values.get((uid, lang))
 
     def any_value(self, pred: str, uid: int) -> Optional[TypedValue]:
         """The untagged value, else any language's value (list.go:835)."""

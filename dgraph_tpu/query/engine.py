@@ -125,7 +125,11 @@ class QueryEngine:
             dest = self._apply_filter(sg.filter, dest, resolver)
         dest = self._order_and_paginate_root(sg, dest, value_vars)
         sg.dest_uids = dest
-        if sg.params.is_recurse:
+        if sg.params.is_groupby:
+            from dgraph_tpu.query.groupby import process_groupby
+
+            process_groupby(self, sg, value_vars)  # root @groupby
+        elif sg.params.is_recurse:
             from dgraph_tpu.query.recurse import recurse
 
             recurse(self, sg, resolver)
@@ -152,6 +156,44 @@ class QueryEngine:
         self._expand_expand_nodes(sg, value_vars)
         for child in sg.children:
             self._exec_child(child, src, resolver, uid_vars, value_vars)
+        if sg.params.cascade and sg.children:
+            self._cascade_prune(sg)
+
+    def _cascade_prune(self, sg: SubGraph):
+        """Execution-time @cascade: drop uids from dest_uids (and the uid
+        matrix) that lack a result in ANY non-internal child — so vars
+        bound under @cascade see the pruned set, not just the encoder
+        (populateVarMap, query.go:1330-1350)."""
+        dest = sg.dest_uids
+        if not len(dest):
+            return
+        keep_mask = np.ones(len(dest), dtype=bool)
+        for child in sg.children:
+            if child.params.is_internal or child.attr in ("_uid_", "uid"):
+                continue
+            if child.counts is not None:
+                continue  # counts exist for every src uid
+            if child.values:
+                has = np.fromiter(
+                    (int(u) in child.values for u in dest.tolist()),
+                    dtype=bool, count=len(dest),
+                )
+            elif len(child.seg_ptr) > 1:
+                # child expanded with dest as its src: row-degree > 0
+                degs = np.diff(child.seg_ptr)
+                has = (degs > 0) if len(degs) == len(dest) else np.zeros(
+                    len(dest), dtype=bool
+                )
+            else:
+                has = np.zeros(len(dest), dtype=bool)
+            keep_mask &= has
+            if not keep_mask.any():
+                break
+        if keep_mask.all():
+            return
+        sg.dest_uids = dest[keep_mask]
+        if len(sg.out_flat):
+            self._mask_matrix(sg, sg.dest_uids)
 
     def _expand_expand_nodes(self, sg: SubGraph, value_vars):
         """expand(_all_) / expand(val(v)) → concrete children
@@ -265,10 +307,12 @@ class QueryEngine:
 
         if not is_uid_pred:
             # value leaf: fetch typed values for each src uid — direct
-            # dict probes on the predicate's value map (store.value call
-            # overhead removed from the hot loop; lang fallback semantics
-            # identical: each tagged lookup falls back to untagged)
+            # dict probes on the predicate's value map (no store.value
+            # call overhead on the hot loop)
             child.src_uids = src
+            # reference v0.7 lang semantics (query_test.go TestLang*):
+            # no @ → untagged only; @a:b → first EXACT match in chain
+            # order, no implicit fallback; '.' → untagged else any lang
             langs = child.langs or [""]
             vals = {}
             pd = self.store.peek(attr)
@@ -280,11 +324,10 @@ class QueryEngine:
                         if tv is not None:
                             vals[u] = tv
                 else:
+                    any_map = _any_value_map(pd) if "." in langs else None
                     for u in src.tolist():
                         for l in langs:
-                            tv = pv.get((u, l))
-                            if tv is None and l:
-                                tv = pv.get((u, ""))
+                            tv = any_map.get(u) if l == "." else pv.get((u, l))
                             if tv is not None:
                                 vals[u] = tv
                                 break
@@ -460,7 +503,11 @@ class QueryEngine:
         def key(u: int):
             v = None
             for l in langs or [""]:
-                v = self.store.value(attr, u, l)
+                v = (
+                    self.store.any_value(attr, u)
+                    if l == "."
+                    else self.store.value(attr, u, l)
+                )
                 if v is not None:
                     break
             return sort_key(v) if v is not None else (9,)
@@ -504,20 +551,18 @@ class QueryEngine:
         return perm[:n].astype(np.int64)  # padding sorts to the tail
 
     def _host_order_perm(
-        self, out: np.ndarray, owner: np.ndarray, n_segs: int, key, desc: bool
+        self, n_items: int, owner: np.ndarray, n_segs: int, key_at, desc: bool
     ) -> np.ndarray:
-        """Per-segment stable python sort (string keys / vars / lang
-        fallback).  Returns a permutation of range(len(out))."""
-        perm = np.arange(len(out), dtype=np.int64)
+        """Per-segment stable python sort (string keys / vars / facet
+        keys).  ``key_at(j)`` keys by flat item index; returns a
+        permutation of range(n_items)."""
+        perm = np.arange(n_items, dtype=np.int64)
         starts = np.zeros(n_segs + 1, dtype=np.int64)
         np.cumsum(np.bincount(owner, minlength=n_segs), out=starts[1:])
         for i in range(n_segs):
             lo, hi = int(starts[i]), int(starts[i + 1])
             if hi - lo > 1:
-                seg_idx = sorted(
-                    range(lo, hi), key=lambda j: key(int(out[j])), reverse=desc
-                )
-                perm[lo:hi] = seg_idx
+                perm[lo:hi] = sorted(range(lo, hi), key=key_at, reverse=desc)
         return perm
 
     def _order_and_paginate_root(self, sg: SubGraph, dest: np.ndarray, value_vars) -> np.ndarray:
@@ -556,19 +601,12 @@ class QueryEngine:
 
             def fkey_at(j: int):
                 src = int(sg.src_uids[owner[j]])
-                f = sg.edge_facets.get((src, int(out[j])), {})
-                v = f.get(fkey_name)
+                v = sg.edge_facets.get((src, int(out[j])), {}).get(fkey_name)
                 return sort_key(v) if v is not None else (9,)
 
-            perm = np.arange(len(out), dtype=np.int64)
-            starts = np.zeros(n_segs + 1, dtype=np.int64)
-            np.cumsum(counts, out=starts[1:])
-            for i in range(n_segs):
-                lo, hi = int(starts[i]), int(starts[i + 1])
-                if hi - lo > 1:
-                    perm[lo:hi] = sorted(
-                        range(lo, hi), key=fkey_at, reverse=p.facets.order_desc
-                    )
+            perm = self._host_order_perm(
+                len(out), owner, n_segs, fkey_at, p.facets.order_desc
+            )
             out, owner = out[perm], owner[perm]
         elif p.order_attr:
             perm = None
@@ -578,7 +616,10 @@ class QueryEngine:
                 key = self._value_key_fn(
                     p.order_attr, p.order_langs, value_vars, p.order_is_var
                 )
-                perm = self._host_order_perm(out, owner, n_segs, key, p.order_desc)
+                perm = self._host_order_perm(
+                    len(out), owner, n_segs,
+                    lambda j: key(int(out[j])), p.order_desc,
+                )
             out, owner = out[perm], owner[perm]
 
         # -- after + per-segment windowing (vectorized, no python loop) -----
@@ -689,6 +730,16 @@ def _apply_edge_mask(sg: SubGraph, mask: np.ndarray) -> None:
     np.cumsum(kept, out=sg.seg_ptr[1:])
 
 
+def _any_value_map(pd) -> Dict[int, TypedValue]:
+    """uid → value under '.' fallback: untagged wins, else the
+    lexicographically-first language (deterministic; list.go:835)."""
+    out: Dict[int, TypedValue] = {}
+    for (u, l) in sorted(pd.values.keys(), key=lambda k: (k[0], k[1] != "", k[1])):
+        if u not in out:
+            out[u] = pd.values[(u, l)]
+    return out
+
+
 def _window_segments(
     out: np.ndarray, owner: np.ndarray, n_segs: int, offset: int, first: int
 ) -> Tuple[np.ndarray, np.ndarray]:
@@ -782,7 +833,10 @@ def _eval_math_vec(mt: MathTree, ua: np.ndarray, value_vars):
     for c in mt.children:
         v, o = _eval_math_vec(c, ua, value_vars)
         kid_vals.append(v)
-        ok &= o
+        # a non-finite lane in ANY subexpression drops the uid — the
+        # per-uid path evaluated every child eagerly, so an undefined
+        # untaken cond() branch also killed the uid there
+        ok &= o & np.isfinite(v)
     if fn in _MATH_BIN and len(kid_vals) == 2:
         return _MATH_BIN[fn](kid_vals[0], kid_vals[1]), ok
     if fn in _MATH_UNARY and len(kid_vals) == 1:

@@ -66,7 +66,15 @@ class FuncResolver:
         if name == "uid":
             out = np.array(sorted(set(fn.uid_args)), dtype=np.int64)
             for ref in fn.needs_vars:
-                out = np.union1d(out, self.uid_vars.get(ref.name, _EMPTY))
+                if ref.name in self.uid_vars:
+                    out = np.union1d(out, self.uid_vars[ref.name])
+                elif ref.name in self.value_vars:
+                    # uid(v) over a VALUE var uses its uid keys
+                    # (uid(val-var) semantics, query.go fillVars)
+                    vm = self.value_vars[ref.name]
+                    out = np.union1d(
+                        out, np.fromiter(vm.keys(), dtype=np.int64, count=len(vm))
+                    )
             if candidates is not None:
                 out = np.intersect1d(out, candidates)
             return out
@@ -139,7 +147,11 @@ class FuncResolver:
         for u in uids.tolist():
             v = None
             for l in langs:
-                v = self.store.value(pred, int(u), l)
+                v = (
+                    self.store.any_value(pred, int(u))
+                    if l == "."
+                    else self.store.value(pred, int(u), l)
+                )
                 if v is not None:
                     break
             if v is not None and compare_vals(op, v, val):
@@ -190,8 +202,10 @@ class FuncResolver:
         else:  # gt
             lo, hi = idx.row_range(lo=token, lo_open=True)
         cand = self._expand_rows(idx.csr, np.arange(lo, hi))
-        if tk.lossy:
-            # e.g. float buckets / year buckets include near-misses
+        if tk.lossy or fn.lang:
+            # lossy buckets include near-misses; lang-tagged functions
+            # must verify the match against the TAGGED value only (the
+            # index spans every language, task.go:612-661 lang filters)
             cand = self._host_recheck(pred, cand, op, val, fn.lang)
         return cand
 
@@ -269,7 +283,11 @@ class FuncResolver:
         langs = fn.lang.split(",") if fn.lang else [""]
         for u in cand.tolist():
             for l in langs:
-                v = self.store.value(fn.attr, int(u), l)
+                v = (
+                    self.store.any_value(fn.attr, int(u))
+                    if l == "."
+                    else self.store.value(fn.attr, int(u), l)
+                )
                 if v is not None and rx.search(str(v.value)):
                     out.append(u)
                     break

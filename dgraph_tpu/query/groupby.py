@@ -35,7 +35,15 @@ def process_groupby(engine, sg: SubGraph, value_vars=None):
                     disp[attr] = _uid_hex(t)
                     break
             else:
-                v = engine.store.value(attr, int(u), lang)
+                v = None
+                for l in (lang.split(":") if lang else [""]):
+                    v = (
+                        engine.store.any_value(attr, int(u))
+                        if l == "."
+                        else engine.store.value(attr, int(u), l)
+                    )
+                    if v is not None:
+                        break
                 if v is None:
                     key_parts.append(("v", attr, None))
                 else:

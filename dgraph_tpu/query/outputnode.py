@@ -53,7 +53,11 @@ def json_value(v: TypedValue) -> Any:
     return v.value
 
 
-def _facets_json(f: Dict[str, TypedValue]) -> Dict[str, Any]:
+def _facets_json(f: Dict[str, TypedValue], spec=None) -> Dict[str, Any]:
+    """Facet map → JSON, restricted to the requested keys when @facets
+    named specific ones (query/outputnode.go facet selection)."""
+    if spec is not None and spec.keys and not spec.all_keys:
+        return {k: json_value(v) for k, v in f.items() if k in spec.keys}
     return {k: json_value(v) for k, v in f.items()}
 
 
@@ -133,13 +137,21 @@ def encode_node(
             if child.groups is not None:
                 obj[key] = [{"@groupby": child.groups}]
             continue
+        if child.func is not None and child.func.name == "checkpwd":
+            v = child.values.get(uid)
+            if v is not None:
+                # reference shape: "pwd": [{"checkpwd": true}]
+                obj[child.alias or attr] = [{"checkpwd": bool(v.value)}]
+            continue
         if child.is_value_node() or (not len(child.out_flat) and child.values):
             v = child.values.get(uid)
             if v is not None:
                 obj[key] = json_value(v)
                 f = child.value_facets.get(uid)
                 if f and child.params.facets:
-                    obj.setdefault("@facets", {})[key] = _facets_json(f)
+                    fj = _facets_json(f, child.params.facets)
+                    if fj:
+                        obj.setdefault("@facets", {})[key] = fj
             elif sg.params.cascade:
                 cascade_fail = True
             continue
@@ -156,7 +168,9 @@ def encode_node(
                         continue
                     f = child.edge_facets.get((uid, int(dst)))
                     if f and child.params.facets is not None:
-                        sub = {**sub, "@facets": {"_": _facets_json(f)}}
+                        fj = _facets_json(f, child.params.facets)
+                        if fj:
+                            sub = {**sub, "@facets": {"_": fj}}
                     if sub:
                         items.append(sub)
                 for gc in child.children:
@@ -219,6 +233,8 @@ def _normalize_flatten(store, sg: SubGraph, uid: int) -> Optional[List[dict]]:
 
 
 def encode_block(store: PostingStore, sg: SubGraph) -> List[dict]:
+    if sg.params.is_groupby and sg.groups is not None:
+        return [{"@groupby": sg.groups}]  # root-level @groupby (GroupByRoot)
     out: List[dict] = []
     bare_count = any(
         c.params.do_count and c.attr == "" for c in sg.children

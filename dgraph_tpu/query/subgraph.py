@@ -152,7 +152,17 @@ def build_subgraph(gq: GraphQuery) -> SubGraph:
             child.reverse = True
             child.attr = child.attr[1:]
         sg.children.append(child)
+    if p.cascade:
+        _mark_cascade(sg)
     return sg
+
+
+def _mark_cascade(sg: SubGraph) -> None:
+    """@cascade applies to the whole subtree below the annotated node
+    (the reference copies Cascade into every treeCopy, query.go:702)."""
+    for c in sg.children:
+        c.params.cascade = True
+        _mark_cascade(c)
 
 
 def _uid_of(s: str) -> int:

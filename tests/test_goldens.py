@@ -1,0 +1,700 @@
+"""Query-surface golden suite (VERDICT r1 missing #5 / next #8).
+
+Re-expresses the SEMANTICS of the reference's query_test.go behavior
+inventory (358 tests over langs, filters, order×pagination, vars, agg,
+math, facets, cascade/normalize, fragments, alias...) on an ORIGINAL
+fixture graph — behaviors are pinned by fresh golden JSON, not by
+translated fixtures or copied goldens.
+"""
+
+import pytest
+
+from dgraph_tpu.models import PostingStore
+from dgraph_tpu.query import QueryEngine
+
+SCHEMA = """
+    name: string @index(term, exact, trigram) .
+    age: int @index(int) .
+    weight: float @index(float) .
+    dob: datetime @index(year) .
+    wild: bool @index(bool) .
+    cares_for: uid @reverse @count .
+    friend: uid @reverse @count .
+    pet: uid .
+    pwd: password .
+"""
+
+# keepers 0x1-0x4, animals 0xa-0xe; Ann cares for three animals, Ben two
+RDF = r"""
+    <0x1> <name> "Ann" .
+    <0x1> <name> "Анна"@ru .
+    <0x1> <name> "Anna"@hu .
+    <0x2> <name> "Ben" .
+    <0x2> <name> "Бен"@ru .
+    <0x3> <name> "Cara Lee" .
+    <0x4> <name> "Dan" .
+    <0x5> <name> "Ann Lee" .
+
+    <0x1> <age> "31" .
+    <0x2> <age> "29" .
+    <0x3> <age> "40" .
+    <0x4> <age> "29" .
+
+    <0x1> <weight> "62.5" .
+    <0x2> <weight> "81.0" .
+    <0x3> <weight> "55.25" .
+
+    <0x1> <dob> "1990-05-02" .
+    <0x2> <dob> "1992-11-20" .
+    <0x3> <dob> "1981-01-15" .
+
+    <0x1> <wild> "false" .
+    <0xa> <wild> "true" .
+
+    <0xa> <name> "Asha" .
+    <0xb> <name> "Bo" .
+    <0xc> <name> "Cleo" .
+    <0xd> <name> "Dodo" .
+    <0xe> <name> "Ember" .
+    <0xa> <age> "5" .
+    <0xb> <age> "2" .
+    <0xc> <age> "9" .
+    <0xd> <age> "2" .
+
+    <0x1> <cares_for> <0xa> (since=2019-04-01, level=3) .
+    <0x1> <cares_for> <0xb> (since=2021-06-10, level=1) .
+    <0x1> <cares_for> <0xc> (since=2020-01-05, level=2) .
+    <0x2> <cares_for> <0xd> (since=2018-09-12, level=5) .
+    <0x2> <cares_for> <0xe> .
+    <0x3> <cares_for> <0xa> .
+
+    <0x1> <friend> <0x2> .
+    <0x1> <friend> <0x3> .
+    <0x2> <friend> <0x3> .
+    <0x3> <friend> <0x4> .
+    <0x4> <friend> <0x1> .
+
+    <0x2> <pet> <0xd> .
+"""
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = QueryEngine(PostingStore())
+    e.run("mutation { schema { %s } set { %s } }" % (SCHEMA, RDF))
+    e.run('mutation { set { <0x4> <pwd> "hunter2" . } }')
+    return e
+
+
+def q(eng, text, variables=None):
+    return eng.run(text, variables)
+
+
+# ---------------------------------------------------------------- langs
+
+
+def test_lang_untagged_default(eng):
+    assert q(eng, "{ me(func: uid(0x1)) { name } }") == {
+        "me": [{"name": "Ann"}]
+    }
+
+
+def test_lang_single(eng):
+    assert q(eng, "{ me(func: uid(0x1)) { name@ru } }") == {
+        "me": [{"name@ru": "Анна"}]
+    }
+
+
+def test_lang_single_miss_is_absent(eng):
+    # no @fr value and NO fallback: the field is simply absent
+    assert q(eng, "{ me(func: uid(0x1)) { name@fr } }") == {"me": []}
+
+
+def test_lang_untagged_miss_no_fallback_to_tagged(eng):
+    eng2 = QueryEngine(PostingStore())
+    eng2.run('mutation { set { <0x9> <name> "Кот"@ru . } }')
+    assert q(eng2, "{ me(func: uid(0x9)) { name } }") == {"me": []}
+
+
+def test_lang_chain_first_match(eng):
+    assert q(eng, "{ me(func: uid(0x1)) { name@fr:ru:hu } }") == {
+        "me": [{"name@fr:ru:hu": "Анна"}]
+    }
+
+
+def test_lang_chain_second_entity(eng):
+    assert q(eng, "{ me(func: uid(0x2)) { name@hu:ru } }") == {
+        "me": [{"name@hu:ru": "Бен"}]
+    }
+
+
+def test_lang_chain_all_miss(eng):
+    assert q(eng, "{ me(func: uid(0x1)) { name@fr:de } }") == {"me": []}
+
+
+def test_lang_forced_fallback_untagged_wins(eng):
+    assert q(eng, "{ me(func: uid(0x1)) { name@fr:. } }") == {
+        "me": [{"name@fr:.": "Ann"}]
+    }
+
+
+def test_lang_forced_fallback_any(eng):
+    eng2 = QueryEngine(PostingStore())
+    eng2.run('mutation { set { <0x9> <name> "Кот"@ru . } }')
+    assert q(eng2, "{ me(func: uid(0x9)) { name@. } }") == {
+        "me": [{"name@.": "Кот"}]
+    }
+
+
+def test_lang_alias(eng):
+    assert q(eng, "{ me(func: uid(0x1)) { ru_name: name@ru } }") == {
+        "me": [{"ru_name": "Анна"}]
+    }
+
+
+def test_lang_filter_exact_match(eng):
+    got = q(eng, '{ me(func: eq(name@ru, "Анна")) { name } }')
+    assert got == {"me": [{"name": "Ann"}]}
+
+
+def test_lang_filter_mismatch(eng):
+    # the untagged value "Ann" must NOT satisfy a @ru-tagged filter
+    got = q(eng, '{ me(func: eq(name@ru, "Ann")) { name } }')
+    assert got == {"me": []}
+
+
+def test_lang_value_and_untagged_together(eng):
+    got = q(eng, "{ me(func: uid(0x1)) { name name@hu } }")
+    assert got == {"me": [{"name": "Ann", "name@hu": "Anna"}]}
+
+
+# ------------------------------------------------------- pagination
+
+
+def test_first_at_child(eng):
+    got = q(eng, "{ me(func: uid(0x1)) { cares_for (first: 2) { name } } }")
+    assert got == {"me": [{"cares_for": [{"name": "Asha"}, {"name": "Bo"}]}]}
+
+
+def test_offset_at_child(eng):
+    got = q(eng, "{ me(func: uid(0x1)) { cares_for (offset: 1) { name } } }")
+    assert got == {"me": [{"cares_for": [{"name": "Bo"}, {"name": "Cleo"}]}]}
+
+
+def test_first_offset_combo(eng):
+    got = q(eng, "{ me(func: uid(0x1)) { cares_for (first: 1, offset: 1) { name } } }")
+    assert got == {"me": [{"cares_for": [{"name": "Bo"}]}]}
+
+
+def test_offset_out_of_bound(eng):
+    got = q(eng, "{ me(func: uid(0x1)) { cares_for (offset: 100) { name } } }")
+    assert got == {"me": []}
+
+
+def test_first_negative_takes_last(eng):
+    got = q(eng, "{ me(func: uid(0x1)) { cares_for (first: -1) { name } } }")
+    assert got == {"me": [{"cares_for": [{"name": "Cleo"}]}]}
+
+
+def test_after_uid(eng):
+    got = q(eng, "{ me(func: uid(0x1)) { cares_for (after: 0xa) { name } } }")
+    assert got == {"me": [{"cares_for": [{"name": "Bo"}, {"name": "Cleo"}]}]}
+
+
+def test_first_at_root(eng):
+    got = q(eng, "{ me(func: has(age), first: 2) { name } }")
+    assert got == {"me": [{"name": "Ann"}, {"name": "Ben"}]}
+
+
+def test_root_offset_and_first(eng):
+    got = q(eng, "{ me(func: has(age), first: 2, offset: 2) { name } }")
+    assert got == {"me": [{"name": "Cara Lee"}, {"name": "Dan"}]}
+
+
+# ------------------------------------------------------- filters
+
+
+def test_filter_eq_string(eng):
+    got = q(eng, '{ me(func: has(age)) @filter(eq(name, "Ben")) { name } }')
+    assert got == {"me": [{"name": "Ben"}]}
+
+
+def test_filter_anyofterms(eng):
+    got = q(eng, '{ me(func: has(age)) @filter(anyofterms(name, "Ann Dan")) { name } }')
+    assert got == {"me": [{"name": "Ann"}, {"name": "Dan"}]}
+
+
+def test_filter_allofterms(eng):
+    got = q(eng, '{ me(func: has(name)) @filter(allofterms(name, "Lee Ann")) { name } }')
+    assert got == {"me": [{"name": "Ann Lee"}]}
+
+
+def test_filter_and(eng):
+    got = q(eng, '{ me(func: has(age)) @filter(ge(age, 29) AND le(age, 31)) { name age } }')
+    assert got == {"me": [{"name": "Ann", "age": 31}, {"name": "Ben", "age": 29},
+                          {"name": "Dan", "age": 29}]}
+
+
+def test_filter_or(eng):
+    got = q(eng, '{ me(func: has(dob)) @filter(eq(age, 40) OR eq(name, "Ann")) { name } }')
+    assert got == {"me": [{"name": "Ann"}, {"name": "Cara Lee"}]}
+
+
+def test_filter_not(eng):
+    got = q(eng, '{ me(func: has(dob)) @filter(NOT eq(name, "Ann")) { name } }')
+    assert got == {"me": [{"name": "Ben"}, {"name": "Cara Lee"}]}
+
+
+def test_filter_not_and(eng):
+    got = q(eng, '{ me(func: has(dob)) @filter(NOT (eq(name, "Ann") OR eq(name, "Ben"))) { name } }')
+    assert got == {"me": [{"name": "Cara Lee"}]}
+
+
+def test_filter_on_child_edge(eng):
+    got = q(eng, '{ me(func: uid(0x1)) { cares_for @filter(ge(age, 5)) { name } } }')
+    assert got == {"me": [{"cares_for": [{"name": "Asha"}, {"name": "Cleo"}]}]}
+
+
+def test_filter_le_lt_ge_gt(eng):
+    assert q(eng, "{ me(func: le(age, 29)) { name } }")["me"] == [
+        {"name": "Ben"}, {"name": "Dan"}, {"name": "Asha"}, {"name": "Bo"},
+        {"name": "Cleo"}, {"name": "Dodo"},
+    ]
+    assert q(eng, "{ me(func: lt(age, 29)) { name } }")["me"] == [
+        {"name": "Asha"}, {"name": "Bo"}, {"name": "Cleo"}, {"name": "Dodo"},
+    ]
+    assert q(eng, "{ me(func: gt(age, 31)) { name } }")["me"] == [
+        {"name": "Cara Lee"},
+    ]
+
+
+def test_filter_eq_multiple_args_union(eng):
+    got = q(eng, '{ me(func: eq(age, 40, 31)) { name } }')
+    assert got == {"me": [{"name": "Ann"}, {"name": "Cara Lee"}]}
+
+
+def test_filter_float_ineq(eng):
+    got = q(eng, "{ me(func: ge(weight, 60.0)) { name weight } }")
+    assert got == {"me": [{"name": "Ann", "weight": 62.5},
+                          {"name": "Ben", "weight": 81.0}]}
+
+
+def test_filter_datetime_year(eng):
+    got = q(eng, '{ me(func: ge(dob, "1990-01-01")) { name } }')
+    assert got == {"me": [{"name": "Ann"}, {"name": "Ben"}]}
+
+
+def test_bool_index_eq(eng):
+    got = q(eng, '{ me(func: eq(wild, "true")) { name } }')
+    assert got == {"me": [{"name": "Asha"}]}
+
+
+def test_filter_uid_list(eng):
+    got = q(eng, "{ me(func: has(age)) @filter(uid(0x2, 0xc)) { name } }")
+    assert got == {"me": [{"name": "Ben"}, {"name": "Cleo"}]}
+
+
+def test_filter_regexp(eng):
+    got = q(eng, "{ me(func: regexp(name, /^Ann/)) { name } }")
+    assert got == {"me": [{"name": "Ann"}, {"name": "Ann Lee"}]}
+
+
+def test_filter_on_count_of_edge(eng):
+    got = q(eng, "{ me(func: has(cares_for)) @filter(ge(count(cares_for), 2)) { name } }")
+    assert got == {"me": [{"name": "Ann"}, {"name": "Ben"}]}
+
+
+def test_filter_no_hit(eng):
+    assert q(eng, '{ me(func: eq(name, "Nobody")) { name } }') == {"me": []}
+
+
+def test_has_at_root(eng):
+    got = q(eng, "{ me(func: has(pet)) { name } }")
+    assert got == {"me": [{"name": "Ben"}]}
+
+
+def test_has_in_filter(eng):
+    got = q(eng, "{ me(func: has(age)) @filter(has(weight)) { name } }")
+    assert got == {"me": [{"name": "Ann"}, {"name": "Ben"}, {"name": "Cara Lee"}]}
+
+
+# --------------------------------------------------- order × pagination
+
+
+def test_order_asc_int_root(eng):
+    got = q(eng, "{ me(func: has(dob), orderasc: age) { name age } }")
+    assert got["me"] == [{"name": "Ben", "age": 29}, {"name": "Ann", "age": 31},
+                         {"name": "Cara Lee", "age": 40}]
+
+
+def test_order_desc_int_root(eng):
+    got = q(eng, "{ me(func: has(dob), orderdesc: age) { name } }")
+    assert got["me"] == [{"name": "Cara Lee"}, {"name": "Ann"}, {"name": "Ben"}]
+
+
+def test_order_string_root(eng):
+    got = q(eng, "{ me(func: has(dob), orderasc: name) { name } }")
+    assert got["me"] == [{"name": "Ann"}, {"name": "Ben"}, {"name": "Cara Lee"}]
+
+
+def test_order_datetime(eng):
+    got = q(eng, "{ me(func: has(dob), orderasc: dob) { name } }")
+    assert got["me"] == [{"name": "Cara Lee"}, {"name": "Ann"}, {"name": "Ben"}]
+
+
+def test_order_with_first_offset(eng):
+    got = q(eng, "{ me(func: has(age), orderdesc: age, first: 2, offset: 1) { name age } }")
+    assert got["me"] == [{"name": "Ann", "age": 31}, {"name": "Ben", "age": 29}]
+
+
+def test_order_child_edge(eng):
+    got = q(eng, "{ me(func: uid(0x1)) { cares_for (orderdesc: age) { name age } } }")
+    assert got == {"me": [{"cares_for": [
+        {"name": "Cleo", "age": 9}, {"name": "Asha", "age": 5},
+        {"name": "Bo", "age": 2}]}]}
+
+
+def test_order_missing_values_last_asc(eng):
+    # Ember has no age: sorts last ascending
+    got = q(eng, "{ me(func: uid(0x2)) { cares_for (orderasc: age) { name } } }")
+    assert got == {"me": [{"cares_for": [{"name": "Dodo"}, {"name": "Ember"}]}]}
+
+
+def test_order_then_count_alias(eng):
+    got = q(eng, "{ me(func: has(cares_for), orderasc: name) { name n: count(cares_for) } }")
+    assert got["me"] == [{"name": "Ann", "n": 3}, {"name": "Ben", "n": 2},
+                         {"name": "Cara Lee", "n": 1}]
+
+
+def test_order_ties_stable_by_uid(eng):
+    got = q(eng, "{ me(func: has(dob), orderasc: age, first: 1) { name } }")
+    assert got["me"] == [{"name": "Ben"}]
+
+
+# --------------------------------------------------- counts
+
+
+def test_count_child(eng):
+    got = q(eng, "{ me(func: uid(0x1)) { count(cares_for) } }")
+    assert got == {"me": [{"count(cares_for)": 3}]}
+
+
+def test_count_reverse(eng):
+    got = q(eng, "{ me(func: uid(0xa)) { count(~cares_for) } }")
+    assert got == {"me": [{"count(~cares_for)": 2}]}
+
+
+def test_count_alias(eng):
+    got = q(eng, "{ me(func: uid(0x2)) { animals: count(cares_for) } }")
+    assert got == {"me": [{"animals": 2}]}
+
+
+def test_count_zero_edge(eng):
+    got = q(eng, "{ me(func: uid(0x4)) { count(cares_for) } }")
+    assert got == {"me": [{"count(cares_for)": 0}]}
+
+
+def test_reverse_expansion(eng):
+    got = q(eng, "{ me(func: uid(0xa)) { ~cares_for { name } } }")
+    assert got == {"me": [{"~cares_for": [{"name": "Ann"}, {"name": "Cara Lee"}]}]}
+
+
+# --------------------------------------------------- vars
+
+
+def test_uid_var_across_blocks(eng):
+    got = q(eng, """{
+      A as var(func: eq(name, "Ann")) { f as friend }
+      me(func: uid(f)) @filter(NOT uid(A)) { name }
+    }""")
+    assert got == {"me": [{"name": "Ben"}, {"name": "Cara Lee"}]}
+
+
+def test_var_chain_two_hops(eng):
+    got = q(eng, """{
+      var(func: uid(0x1)) { friend { ff as friend } }
+      me(func: uid(ff)) { name }
+    }""")
+    assert got == {"me": [{"name": "Cara Lee"}, {"name": "Dan"}]}
+
+
+def test_value_var_in_ineq(eng):
+    # reference form (TestVarInIneq): the value var feeds a val() filter
+    got = q(eng, """{
+      var(func: has(dob)) { a as age }
+      me(func: uid(a)) @filter(ge(val(a), 31)) { name age }
+    }""")
+    assert got == {"me": [{"name": "Ann", "age": 31}, {"name": "Cara Lee", "age": 40}]}
+
+
+def test_value_var_order(eng):
+    got = q(eng, """{
+      var(func: has(dob)) { a as age }
+      me(func: uid(a), orderdesc: val(a)) { name }
+    }""")
+    assert got["me"] == [{"name": "Cara Lee"}, {"name": "Ann"}, {"name": "Ben"}]
+
+
+def test_var_reuse_in_two_filters(eng):
+    got = q(eng, """{
+      B as var(func: eq(name, "Ben")) { name }
+      x(func: has(dob)) @filter(uid(B)) { name }
+      y(func: has(age)) @filter(NOT uid(B)) { count() }
+    }""")
+    assert got["x"] == [{"name": "Ben"}]
+    assert got["y"] == [{"count": 7}]  # bare count() at root (CountAtRoot)
+
+
+def test_val_fetch_in_child(eng):
+    got = q(eng, """{
+      var(func: uid(0x1)) { cares_for { a as age } }
+      me(func: uid(0x1)) { cares_for { name val(a) } }
+    }""")
+    assert got == {"me": [{"cares_for": [
+        {"name": "Asha", "val(a)": 5}, {"name": "Bo", "val(a)": 2},
+        {"name": "Cleo", "val(a)": 9}]}]}
+
+
+# --------------------------------------------------- aggregation & math
+
+
+def test_agg_min_max_sum_avg(eng):
+    got = q(eng, """{
+      var(func: has(dob)) { a as age }
+      stats() {
+        mn: min(val(a)) mx: max(val(a)) sm: sum(val(a)) av: avg(val(a))
+      }
+    }""")
+    s = got["stats"][0]
+    assert s["mn"] == 29 and s["mx"] == 40 and s["sm"] == 100.0
+    assert abs(s["av"] - 100 / 3) < 1e-9
+
+
+def test_agg_min_datetime_keeps_type(eng):
+    got = q(eng, """{
+      var(func: has(dob)) { d as dob }
+      s() { first: min(val(d)) }
+    }""")
+    assert got["s"][0]["first"].startswith("1981-01-15")
+
+
+def test_math_const(eng):
+    got = q(eng, """{
+      var(func: uid(0x1)) { a as age }
+      me(func: uid(0x1)) { m: math(a + 1) }
+    }""")
+    assert got == {"me": [{"m": 32.0}]}
+
+
+def test_math_nested_funcs(eng):
+    got = q(eng, """{
+      var(func: uid(0x1, 0x3)) { a as age }
+      me(func: uid(0x1, 0x3), orderasc: age) { name m: math(sqrt(a * a)) }
+    }""")
+    assert got["me"] == [{"name": "Ann", "m": 31.0}, {"name": "Cara Lee", "m": 40.0}]
+
+
+def test_math_cond(eng):
+    got = q(eng, """{
+      var(func: has(dob)) { a as age }
+      me(func: has(dob), orderasc: age) { name m: math(cond(a > 30, 1, 0)) }
+    }""")
+    assert got["me"] == [{"name": "Ben", "m": 0.0}, {"name": "Ann", "m": 1.0},
+                         {"name": "Cara Lee", "m": 1.0}]
+
+
+def test_math_division_drop_undefined(eng):
+    got = q(eng, """{
+      var(func: has(dob)) { a as age }
+      me(func: has(dob), orderasc: age) { name m: math(1.0 / (a - 29)) }
+    }""")
+    # Ben (age 29) divides by zero: his m is dropped, others remain
+    assert got["me"] == [{"name": "Ben"}, {"name": "Ann", "m": 0.5},
+                         {"name": "Cara Lee", "m": 1.0 / 11}]
+
+
+# --------------------------------------------------- facets
+
+
+def test_facets_on_edges(eng):
+    got = q(eng, "{ me(func: uid(0x2)) { cares_for @facets(level) { name } } }")
+    # requested keys only, under the reference's "@facets": {"_": ...} shape
+    assert got == {"me": [{"cares_for": [
+        {"name": "Dodo", "@facets": {"_": {"level": 5}}},
+        {"name": "Ember"}]}]}
+
+
+def test_facet_filter_eq(eng):
+    got = q(eng, '{ me(func: uid(0x1)) { cares_for @facets(eq(level, 2)) { name } } }')
+    assert got == {"me": [{"cares_for": [{"name": "Cleo"}]}]}
+
+
+def test_facet_filter_ge(eng):
+    got = q(eng, '{ me(func: uid(0x1)) { cares_for @facets(ge(level, 2)) { name } } }')
+    assert got == {"me": [{"cares_for": [{"name": "Asha"}, {"name": "Cleo"}]}]}
+
+
+def test_facet_order(eng):
+    got = q(eng, "{ me(func: uid(0x1)) { cares_for @facets(orderasc: level) { name } } }")
+    names = [c["name"] for c in got["me"][0]["cares_for"]]
+    assert names == ["Bo", "Cleo", "Asha"]
+
+
+def test_facet_var(eng):
+    got = q(eng, """{
+      var(func: uid(0x1)) { cares_for @facets(l as level) }
+      me(func: uid(0x1)) { cares_for (orderdesc: val(l)) { name } }
+    }""")
+    names = [c["name"] for c in got["me"][0]["cares_for"]]
+    assert names == ["Asha", "Cleo", "Bo"]
+
+
+def test_facet_datetime_value(eng):
+    got = q(eng, "{ me(func: uid(0x2)) { cares_for @facets(since) { name } } }")
+    first = got["me"][0]["cares_for"][0]
+    assert first["name"] == "Dodo"
+    assert first["@facets"]["_"]["since"].startswith("2018-09-12")
+    assert "level" not in first["@facets"]["_"], "only requested keys"
+
+
+# --------------------------------------------------- cascade / normalize
+
+
+def test_cascade_drops_incomplete(eng):
+    got = q(eng, "{ me(func: uid(0x2)) @cascade { cares_for { name age } } }")
+    # Ember has no age; under @cascade the whole Ember branch drops
+    assert got == {"me": [{"cares_for": [{"name": "Dodo", "age": 2}]}]}
+
+
+def test_cascade_no_match_drops_root(eng):
+    got = q(eng, "{ me(func: uid(0x4)) @cascade { name cares_for { name } } }")
+    assert got == {"me": []}
+
+
+def test_normalize_flattens(eng):
+    got = q(eng, """{ me(func: uid(0x1)) @normalize {
+        keeper: name
+        cares_for { animal: name }
+    } }""")
+    assert got == {"me": [
+        {"keeper": "Ann", "animal": "Asha"},
+        {"keeper": "Ann", "animal": "Bo"},
+        {"keeper": "Ann", "animal": "Cleo"},
+    ]}
+
+
+def test_normalize_keeps_only_aliased(eng):
+    got = q(eng, """{ me(func: uid(0x2)) @normalize {
+        name
+        cares_for { a: name }
+    } }""")
+    assert got == {"me": [{"a": "Dodo"}, {"a": "Ember"}]}
+
+
+def test_cascade_with_var(eng):
+    got = q(eng, """{
+      k as var(func: has(cares_for)) @cascade { cares_for { wild } }
+      me(func: uid(k)) { name }
+    }""")
+    # only keepers caring for a wild-flagged animal survive the cascade
+    assert got == {"me": [{"name": "Ann"}, {"name": "Cara Lee"}]}
+
+
+# --------------------------------------------------- fragments / variables
+
+
+def test_fragment_spread(eng):
+    got = q(eng, """
+    query {
+      me(func: uid(0x1)) { ...basics cares_for { ...basics } }
+    }
+    fragment basics { name age }
+    """)
+    assert got["me"][0]["name"] == "Ann"
+    assert got["me"][0]["cares_for"][0] == {"name": "Asha", "age": 5}
+
+
+def test_graphql_variable_substitution(eng):
+    got = eng.run(
+        "query me($a: int) { me(func: ge(age, $a)) { name } }",
+        {"$a": "31"},
+    )
+    assert got == {"me": [{"name": "Ann"}, {"name": "Cara Lee"}]}
+
+
+def test_graphql_variable_default(eng):
+    got = eng.run(
+        "query me($a: int = 40) { me(func: ge(age, $a)) { name } }", {}
+    )
+    assert got == {"me": [{"name": "Cara Lee"}]}
+
+
+# --------------------------------------------------- misc output shapes
+
+
+def test_uid_output(eng):
+    got = q(eng, "{ me(func: eq(name, \"Ben\")) { _uid_ name } }")
+    assert got == {"me": [{"_uid_": "0x2", "name": "Ben"}]}
+
+
+def test_alias_on_edge(eng):
+    got = q(eng, "{ me(func: uid(0x2)) { pals: friend { name } } }")
+    assert got == {"me": [{"pals": [{"name": "Cara Lee"}]}]}
+
+
+def test_duplicate_alias_last_wins_or_both(eng):
+    got = q(eng, "{ me(func: uid(0x1)) { a: age a: weight } }")
+    # both children execute; JSON object keeps one key (the later write)
+    assert got["me"][0]["a"] in (31, 62.5)
+
+
+def test_multi_block_independent(eng):
+    got = q(eng, """{
+      a(func: uid(0x1)) { name }
+      b(func: uid(0x2)) { name }
+    }""")
+    assert got == {"a": [{"name": "Ann"}], "b": [{"name": "Ben"}]}
+
+
+def test_checkpwd(eng):
+    got = q(eng, '{ me(func: uid(0x4)) { checkpwd(pwd, "hunter2") } }')
+    assert got == {"me": [{"pwd": [{"checkpwd": True}]}]}
+    got = q(eng, '{ me(func: uid(0x4)) { checkpwd(pwd, "wrong") } }')
+    assert got == {"me": [{"pwd": [{"checkpwd": False}]}]}
+
+
+def test_expand_all_lists_predicates(eng):
+    got = q(eng, "{ me(func: uid(0xd)) { expand(_all_) } }")
+    keys = set(got["me"][0].keys())
+    assert {"name", "age"} <= keys
+
+
+def test_groupby_with_agg(eng):
+    got = q(eng, """{
+      me(func: uid(0xa, 0xb, 0xc, 0xd)) @groupby(age) { count(_uid_) }
+    }""")
+    groups = got["me"][0]["@groupby"]  # root-level @groupby (GroupByRoot)
+    by_age = {g["age"]: g["count"] for g in groups}
+    assert by_age == {2: 2, 5: 1, 9: 1}
+
+
+def test_recurse_collects_levels(eng):
+    got = q(eng, "{ me(func: uid(0x1)) @recurse(depth: 2) { name friend } }")
+    me = got["me"][0]
+    assert me["name"] == "Ann"
+    assert {f["name"] for f in me["friend"]} == {"Ben", "Cara Lee"}
+
+
+def test_shortest_path_block(eng):
+    got = q(eng, """{
+      path as shortest(from: 0x1, to: 0x4) { friend }
+      path2(func: uid(path)) { name }
+    }""")
+    names = [n["name"] for n in got["path2"]]
+    assert names[0] == "Ann" and names[-1] == "Dan"
+
+
+def test_ignorereflex(eng):
+    got = q(eng, "{ me(func: uid(0x1)) @ignorereflex { friend { friend { name } } } }")
+    inner = got["me"][0]["friend"][0]["friend"]
+    assert all(n["name"] != "Ann" for n in inner)

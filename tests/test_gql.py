@@ -54,7 +54,7 @@ def test_alias_and_langs():
       me(func: uid(0x0a)) {
         name: type.object.name.en
         bestFriend: friends(first: 10) {
-          name@en@de
+          name@en:de
         }
       }
     }""")

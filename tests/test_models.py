@@ -76,7 +76,10 @@ def test_store_mutation_semantics():
     st.set_value("name", 1, TypedValue(TypeID.STRING, "alicia"), lang="es")
     assert st.value("name", 1).value == "alice"
     assert st.value("name", 1, "es").value == "alicia"
-    assert st.value("name", 1, "fr").value == "alice"  # lang fallback
+    # exact-lang semantics: no implicit fallback to untagged (reference
+    # TestLangSingleFallback); '.'-chain fallback goes via any_value
+    assert st.value("name", 1, "fr") is None
+    assert st.any_value("name", 1).value == "alice"
     st.del_value("name", 1)
     assert st.value("name", 1) is None
     assert st.value("name", 1, "es").value == "alicia"
