@@ -40,6 +40,9 @@ def build_options(argv=None) -> Options:
     p.add_argument("--idx", dest="raft_id", type=int, default=d.raft_id)
     p.add_argument("--groups", dest="group_ids", default=d.group_ids)
     p.add_argument("--peer", default=d.peer)
+    p.add_argument("--join", default=d.join,
+                   help="address of a live cluster member; boot as a "
+                        "joining node and acquire membership at runtime")
     p.add_argument("--my", dest="my_addr", default=d.my_addr)
     p.add_argument("--trace", dest="trace_ratio", type=float, default=d.trace_ratio)
     p.add_argument("--expose_trace", action="store_true", default=d.expose_trace)
@@ -69,7 +72,28 @@ def build_options(argv=None) -> Options:
 def main(argv=None) -> int:
     opts = build_options(argv)
     cluster = None
-    if opts.peer:
+    if opts.join and not opts.peer:
+        # runtime join: boot passive with only ourselves, then announce
+        from dgraph_tpu.cluster.service import ClusterService
+
+        scheme = "https" if opts.tls_cert else "http"
+        my_addr = opts.my_addr or f"{scheme}://127.0.0.1:{opts.port}"
+        cluster = ClusterService(
+            node_id=str(opts.raft_id),
+            my_addr=my_addr,
+            peers={str(opts.raft_id): my_addr},
+            group_ids=[int(g) for g in opts.group_ids.split(",") if g.strip()],
+            directory=opts.postings_dir,
+            sync_writes=opts.sync_writes,
+            secret=opts.cluster_secret,
+            peer_ca=opts.peer_ca,
+            peer_tls_insecure=opts.peer_tls_insecure,
+            passive=True,
+        )
+        cluster.start()
+        cluster.join_cluster(opts.join)
+        store = cluster.store
+    elif opts.peer:
         # clustered boot (StartRaftNodes analog): durability lives in the
         # raft logs + snapshots under the postings dir
         from dgraph_tpu.cluster.service import ClusterService, parse_peers

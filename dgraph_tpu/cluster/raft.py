@@ -282,6 +282,7 @@ class RaftNode:
         tick_ms: int = 15,
         election_ticks: int = 10,
         snapshot_threshold: int = 10_000,
+        passive: bool = False,
     ):
         self.node_id = node_id
         self.group = group
@@ -295,6 +296,11 @@ class RaftNode:
         self.election_ticks = election_ticks
         self.snapshot_threshold = snapshot_threshold
 
+        # passive: a joining node that does not yet know the membership —
+        # it never campaigns (it would split-brain-elect itself with an
+        # empty log) until activated by the first add_peer (JoinCluster
+        # analog, draft.go:1049)
+        self.passive = passive
         self.state = FOLLOWER
         self.leader_id: Optional[str] = None
         self.commit_index = storage.snap_index
@@ -337,6 +343,13 @@ class RaftNode:
         self._inbox.put(("propose", data, fut))
         return fut
 
+    def add_peer(self, nid: str) -> None:
+        """Runtime membership addition (single-server change, the
+        simplified ConfChange the reference gets from etcd/raft): the
+        peer joins the replication set and — on the leader — starts
+        receiving appends/snapshots immediately.  Idempotent."""
+        self._inbox.put(("conf_add", nid))
+
     def propose_and_wait(self, data: bytes, timeout: float = 10.0):
         """draft.go:341 ProposeAndWait: block until applied or error."""
         return self.propose(data).result(timeout=timeout)
@@ -365,6 +378,8 @@ class RaftNode:
                     self._handle(item[1])
                 elif kind == "propose":
                     self._handle_propose(item[1], item[2])
+                elif kind == "conf_add":
+                    self._handle_conf_add(item[1])
             except Exception:  # noqa: BLE001 — a bad entry/storage error must
                 # not silently kill the event loop and wedge the group
                 import traceback
@@ -380,9 +395,26 @@ class RaftNode:
         if self.state == LEADER:
             self._broadcast_append()
             return
+        if self.passive:
+            return  # joining node: wait to be contacted, never campaign
         self._elapsed += 1
         if self._elapsed >= self._timeout:
             self._campaign()
+
+    def _handle_conf_add(self, nid: str) -> None:
+        if nid == self.node_id:
+            # learning only our OWN id must not activate a passive joiner:
+            # with an empty peer list it would instantly self-elect and
+            # force the real leader down when their messages cross
+            return
+        if nid not in self.peers:
+            self.peers.append(nid)
+            self.next_index[nid] = self.storage.last_index() + 1
+            self.match_index[nid] = 0
+            if self.state == LEADER:
+                self._send_append(nid)
+        # learning a real peer activates a passive joiner
+        self.passive = False
 
     # -- elections ----------------------------------------------------------
 
@@ -553,9 +585,16 @@ class RaftNode:
         self._step_down(m.term, leader=m.leader)
         prev_term = self.storage.term_at(m.prev_log_index)
         if prev_term is None or prev_term != m.prev_log_term:
+            # prev missing (behind our snapshot / past our log): hint the
+            # leader where to resume as next_index = snap_index + 1.  The
+            # +1 bias keeps the hint truthy even for an EMPTY log
+            # (snap_index 0) — a fresh runtime joiner otherwise degrades
+            # to a one-entry-per-roundtrip backoff walk.  0 = no hint
+            # (term-mismatch case).
             self.transport.send(
                 m.leader, self.group,
-                AppendResp(self.storage.term, False, self.storage.snap_index
+                AppendResp(self.storage.term, False,
+                           self.storage.snap_index + 1
                            if prev_term is None else 0, self.node_id),
             )
             return
@@ -584,10 +623,11 @@ class RaftNode:
             self.next_index[m.sender] = self.match_index[m.sender] + 1
             self._maybe_commit()
         else:
-            # back off; if follower reported its snapshot horizon, jump there
+            # back off; a truthy hint is the follower's snap_index + 1
+            # (jump straight there), 0 means plain log mismatch
             hint = m.match_index
             cur = self.next_index.get(m.sender, self.storage.last_index() + 1)
-            self.next_index[m.sender] = max(1, hint + 1 if hint else cur - 1)
+            self.next_index[m.sender] = max(1, hint if hint else cur - 1)
             self._send_append(m.sender)
 
     def _on_snapshot(self, m: SnapshotReq) -> None:

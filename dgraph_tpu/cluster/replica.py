@@ -59,6 +59,7 @@ def bytes_to_state(data: bytes, store: PostingStore) -> None:
     store._preds.clear()
     store.uids._xid_to_uid.clear()
     store.uids._next = 1
+    store.members.clear()
     store.dirty.add("*")
     pos = 0
     n = len(data)

@@ -111,6 +111,9 @@ class ClusterService:
         }
         self.lease = LeaseManager(self._propose_lease)
         self.store = ClusterStore(self)
+        # runtime membership: MEMBER records applied on the metadata
+        # replica rewire this server live (groups.go applyMembershipUpdate)
+        self.groups[METADATA_GROUP].store.member_hook = self._on_member_applied
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -128,6 +131,82 @@ class ClusterService:
 
     def has_leader(self) -> bool:
         return all(g.node.leader_id is not None for g in self.groups.values())
+
+    # -- runtime membership (JoinCluster, draft.go:1049 / groups.go:600) ----
+
+    def _wait_local_apply(self, cond: Callable[[], bool], timeout: float = 5.0) -> bool:
+        """Poll until a forwarded proposal becomes visible on the LOCAL
+        replica (shared by xid assignment, schema apply and join)."""
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if cond():
+                return True
+            time.sleep(0.005)
+        return False
+
+    def _on_member_applied(self, nid: str, addr: str, groups=()) -> None:
+        """Called (from a raft apply thread) when a MEMBER record lands on
+        the metadata replica: rewire transport + the member's groups'
+        peer sets.  Idempotent; safe on replay and snapshot restore.
+        Dict updates are atomic reference swaps — HTTP handler threads
+        iterate self.peers/addr_of concurrently."""
+        if nid != self.node_id:
+            self.peers = {**self.peers, nid: addr}
+            self.transport.addr_of = {**self.transport.addr_of, nid: addr}
+        member_groups = set(groups) if groups else None
+        for gid, g in self.groups.items():
+            # empty group list = legacy record = member serves every group;
+            # the metadata group always includes every member
+            if member_groups is None or gid in member_groups or gid == METADATA_GROUP:
+                g.node.add_peer(nid)
+
+    def handle_join(self, nid: str, addr: str, groups=()) -> Dict[str, str]:
+        """Server side of a join request: replicate the new member
+        through the metadata group and hand back the full peer map so the
+        joiner can configure itself.  propose_records returning means the
+        membership IS committed (leader applied it); a lagging LOCAL
+        apply only delays this server's own view, so it must not fail
+        the join — the joiner would be a committed member with no
+        removal path."""
+        # propose the FULL membership (idempotent): the metadata log then
+        # carries every member, so a joiner's restart — whose static
+        # config lists only itself — replays the complete peer map
+        records = [
+            codec.encode_member(n, a, sorted(self.groups))
+            for n, a in sorted(self.peers.items())
+        ]
+        records.append(codec.encode_member(nid, addr, sorted(groups)))
+        self.propose_records(METADATA_GROUP, records)
+        meta = self.groups[METADATA_GROUP].store
+        self._wait_local_apply(lambda: nid in meta.members)
+        peers = dict(self.peers)
+        peers[nid] = addr
+        return peers
+
+    def join_cluster(self, seed_addr: str, timeout: float = 15.0) -> None:
+        """Joiner side: announce ourselves to a live cluster via any
+        server, then adopt the returned peer map.  The metadata leader's
+        raft nodes start replicating to us the moment our MEMBER record
+        applies on them; our passive nodes catch up via snapshot+log."""
+        import json as _json
+
+        req = urllib.request.Request(
+            seed_addr.rstrip("/") + "/join",
+            data=_json.dumps(
+                {
+                    "id": self.node_id,
+                    "addr": self.peers[self.node_id],
+                    "groups": sorted(self.groups),
+                }
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urlopen_peer(req, timeout, self.auth) as resp:
+            got = _json.loads(resp.read())
+        for nid, addr in got["peers"].items():
+            self._on_member_applied(nid, addr)
 
     # -- raft plane (server endpoints call these) ---------------------------
 
@@ -296,15 +375,9 @@ class _ClusterUids:
         # the applied map is authoritative (first XID record in log order
         # wins on every replica); on a follower the local apply can lag the
         # leader's commit, so wait for our record to land
-        import time
-
-        deadline = time.time() + 5.0
-        while time.time() < deadline:
-            got = self._meta.lookup(xid)
-            if got is not None:
-                return got
-            time.sleep(0.005)
-        return uid
+        self._svc._wait_local_apply(lambda: self._meta.lookup(xid) is not None)
+        got = self._meta.lookup(xid)
+        return got if got is not None else uid
 
     def lookup(self, xid: str) -> Optional[int]:
         return self._meta.lookup(xid)
@@ -360,25 +433,24 @@ class ClusterStore:
         # LOCAL apply can lag its commit; a set block in the same request
         # would then convert values against the stale schema, durably
         # storing wrong-typed values.  Wait until every proposed predicate
-        # is visible locally (same deadline pattern as _ClusterUids.assign;
-        # later schema records for the same predicate in log order simply
-        # overwrite, so observing our entries is sufficient).
-        import time
-
-        deadline = time.time() + 5.0
-        while time.time() < deadline:
-            local = self.schema._preds
-            if all(local.get(p.name) == p for p in want._preds.values()):
-                return
-            time.sleep(0.005)
-        # the proposal IS durably committed at this point — only the local
-        # apply is lagging.  Say so precisely: retrying the whole request
-        # is safe (same-text schema records are idempotent overwrites), but
-        # the client must know the schema itself did not fail.
-        raise TimeoutError(
-            "schema change committed but not yet applied on this replica "
-            "after 5s; retry the request (idempotent) or query another server"
+        # is visible locally (later schema records for the same predicate
+        # in log order simply overwrite, so observing ours is sufficient).
+        ok = self._svc._wait_local_apply(
+            lambda: all(
+                self.schema._preds.get(p.name) == p
+                for p in want._preds.values()
+            )
         )
+        if not ok:
+            # the proposal IS durably committed at this point — only the
+            # local apply is lagging.  Say so precisely: retrying the whole
+            # request is safe (same-text schema records are idempotent
+            # overwrites), but the client must know the schema itself did
+            # not fail.
+            raise TimeoutError(
+                "schema change committed but not yet applied on this replica "
+                "after 5s; retry the request (idempotent) or query another server"
+            )
 
     # -- reads (snapshot copies of local replicas) --------------------------
 

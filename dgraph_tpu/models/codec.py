@@ -33,6 +33,7 @@ XID = 0x03
 LEASE = 0x04
 DELPRED = 0x05
 BULKEDGES = 0x06
+MEMBER = 0x07   # cluster membership: node_id + serving address
 
 _F_DEL = 1
 _F_VALUE = 2
@@ -255,3 +256,29 @@ def encode_delpred(pred: str) -> bytes:
     buf = bytearray([DELPRED])
     put_str(buf, pred)
     return bytes(buf)
+
+
+def encode_member(node_id: str, addr: str, groups=()) -> bytes:
+    """Runtime membership record (worker/groups.go applyMembershipUpdate
+    analog): replicated through the metadata group so every server —
+    including restarts replaying the log — learns the peer.  ``groups``
+    lists the raft groups the member serves; empty = all (legacy)."""
+    buf = bytearray([MEMBER])
+    put_str(buf, node_id)
+    put_str(buf, addr)
+    put_uvarint(buf, len(groups))
+    for g in groups:
+        put_uvarint(buf, g)
+    return bytes(buf)
+
+
+def decode_member(payload: bytes):
+    nid, pos = get_str(payload, 1)
+    addr, pos = get_str(payload, pos)
+    groups = []
+    if pos < len(payload):
+        n, pos = uvarint(payload, pos)
+        for _ in range(n):
+            g, pos = uvarint(payload, pos)
+            groups.append(g)
+    return nid, addr, groups

@@ -61,6 +61,11 @@ class PostingStore:
         self.uids = UidMap()
         self._preds: Dict[str, PredicateData] = {}
         self.dirty: Set[str] = set()
+        # runtime cluster membership (MEMBER records) — only meaningful
+        # on the metadata group's replica store; member_hook fires on
+        # apply so the cluster service can rewire transports live
+        self.members: Dict[str, str] = {}
+        self.member_hook = None
 
     # -- access ------------------------------------------------------------
 

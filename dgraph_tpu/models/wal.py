@@ -132,6 +132,12 @@ def apply_record(store: PostingStore, payload: bytes) -> None:
     elif tag == codec.DELPRED:
         pred, _ = codec.get_str(payload, 1)
         PostingStore.delete_predicate(store, pred)
+    elif tag == codec.MEMBER:
+        nid, addr, groups = codec.decode_member(payload)
+        store.members[nid] = (addr, tuple(groups))
+        hook = getattr(store, "member_hook", None)
+        if hook is not None:
+            hook(nid, addr, groups)
     else:
         raise ValueError(f"unknown WAL record tag {tag:#x}")
 
@@ -143,6 +149,8 @@ def iter_state_records(store: PostingStore):
     text = store.schema.to_text()
     if text:
         yield codec.encode_schema(text)
+    for nid, (addr, groups) in sorted(store.members.items()):
+        yield codec.encode_member(nid, addr, groups)
     for xid, uid in sorted(store.uids.snapshot().items(), key=lambda kv: kv[1]):
         yield codec.encode_xid(xid, uid)
     yield codec.encode_lease(store.uids._next)

@@ -321,6 +321,22 @@ def _make_handler(srv: DgraphServer):
                 return self._reply(
                     200, json.dumps({"start": start, "end": end}).encode()
                 )
+            if u.path == "/join":
+                # runtime membership: a new server announces itself
+                # (grpc JoinCluster analog, draft.go:1049)
+                raw = self.rfile.read(n)
+                if srv.cluster is None:
+                    return self._err(404, "not clustered")
+                if not self._cluster_authorized():
+                    return self._err(403, "bad cluster secret")
+                try:
+                    body = json.loads(raw)
+                    peers = srv.cluster.handle_join(
+                        str(body["id"]), str(body["addr"])
+                    )
+                except Exception as e:
+                    return self._err(400, str(e))
+                return self._reply(200, json.dumps({"peers": peers}).encode())
             if u.path.startswith("/raft/") or u.path.startswith("/raft-propose/"):
                 # raft plane: binary frames, no engine lock (RaftMessage /
                 # proposeOrSend endpoints, draft.go:1017, mutation.go:319)

@@ -27,6 +27,7 @@ class Options:
     group_ids: str = "0"
     peer: str = ""
     my_addr: str = ""
+    join: str = ""   # address of a live cluster member to join at boot
     workers: int = 4
     # cluster security: shared secret gating the raft/propose/assign
     # endpoints, and the trust model for intra-cluster TLS (pin a CA, or

@@ -219,6 +219,88 @@ def test_schema_then_set_via_follower_converts_with_new_schema(cluster3):
     assert _wait(typed_everywhere), "set converted against stale schema"
 
 
+def test_runtime_server_join(cluster3, tmp_path):
+    """A 4th server joins the LIVE 3-server cluster at runtime
+    (JoinCluster, draft.go:1049 / UpdateMembership, groups.go:600):
+    membership replicates through the metadata group, the joiner catches
+    up via snapshot+log, then serves reads AND accepts writes."""
+    import socket
+
+    # seed data BEFORE the join so catch-up has state to ship
+    out = _post(cluster3[0].addr, "/query", """
+    mutation { schema { name: string @index(exact) . }
+               set { <0x21> <name> "pre-join" . } }""")
+    assert out.get("code") == "Success"
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port4 = s.getsockname()[1]
+    s.close()
+    addr4 = f"http://127.0.0.1:{port4}"
+    svc4 = ClusterService(
+        node_id="4", my_addr=addr4, peers={"4": addr4}, group_ids=[0, 1],
+        directory=str(tmp_path / "n4"), passive=True,
+    )
+    svc4.start()
+    srv4 = DgraphServer(svc4.store, port=port4, cluster=svc4)
+    srv4.start()
+    try:
+        svc4.join_cluster(cluster3[1].addr)
+
+        # every original server must now know node 4
+        assert _wait(lambda: all(
+            "4" in s.cluster.peers for s in cluster3
+        )), "membership did not replicate"
+
+        # the joiner catches up and serves the pre-join data locally
+        def caught_up():
+            try:
+                got = _post(addr4, "/query",
+                            '{ q(func: eq(name, "pre-join")) { name } }')
+                return got.get("q") == [{"name": "pre-join"}]
+            except Exception:
+                return False
+
+        assert _wait(caught_up, timeout=20), "joiner never caught up"
+
+        # writes THROUGH the joiner replicate to the old servers
+        out = _post(addr4, "/query",
+                    'mutation { set { <0x22> <name> "via-joiner" . } }')
+        assert out.get("code") == "Success"
+        assert _wait(lambda: _post(
+            cluster3[0].addr, "/query",
+            '{ q(func: eq(name, "via-joiner")) { name } }'
+        ).get("q") == [{"name": "via-joiner"}]), "joiner write did not replicate"
+    finally:
+        srv4.stop()
+
+    # restart the joiner from its directory ONLY (static config lists
+    # just itself): the replicated MEMBER records restore the full peer
+    # map, so it rejoins without a second join_cluster call
+    svc4b = ClusterService(
+        node_id="4", my_addr=addr4, peers={"4": addr4}, group_ids=[0, 1],
+        directory=str(tmp_path / "n4"), passive=True,
+    )
+    svc4b.start()
+    srv4b = DgraphServer(svc4b.store, port=port4, cluster=svc4b)
+    srv4b.start()
+    try:
+        assert _wait(lambda: "1" in svc4b.peers and "2" in svc4b.peers,
+                     timeout=20), "restart did not replay membership"
+
+        def serves_again():
+            try:
+                got = _post(addr4, "/query",
+                            '{ q(func: eq(name, "via-joiner")) { name } }')
+                return got.get("q") == [{"name": "via-joiner"}]
+            except Exception:
+                return False
+
+        assert _wait(serves_again, timeout=20), "restarted joiner not serving"
+    finally:
+        srv4b.stop()
+
+
 def test_explicit_uid_reservation_reaches_leader(cluster3):
     """An explicit uid written through a FOLLOWER must never be handed out
     later as a fresh uid by the metadata leader, even when it falls inside
