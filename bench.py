@@ -2,9 +2,14 @@
 
 Mirrors BASELINE.json's north-star metric: a Freebase-21M-scale synthetic
 graph (2M nodes, ~21M edges, skewed degrees), 2-hop traversal from random
-seed sets.  The device path (jit expand_csr + sort_unique + rows_of) is
+seed sets.  The device path — chunked CSR expansion (ops.expand_chunked:
+32-byte-granule row gathers + scatter/prefix-sum slot mapping), sort-based
+frontier dedup, one vmapped program for the whole query batch — is
 measured against a fully-vectorized NumPy implementation of the same
 semantics (the stand-in for the reference's CPU posting-list walk).
+Every query's output materializes on device (per-query checksums, all
+verified against numpy), so the edges/s number cannot be faked by XLA
+dead-code elimination.
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
 Environment knobs: BENCH_NODES, BENCH_EDGES, BENCH_SEEDS, BENCH_ITERS,
@@ -116,7 +121,8 @@ def np_two_hop(a, h_dst, frontier):
     out1 = np_expand(a.h_offsets, h_dst, frontier)
     f1 = np.unique(out1)
     out2 = np_expand(a.h_offsets, h_dst, f1)
-    return len(out1) + len(out2), np.unique(out2)
+    chk = np.int32(out2.astype(np.int64).sum() & 0xFFFFFFFF)
+    return len(out1) + len(out2), np.unique(out2), chk
 
 
 def run_bench(scale: float):
@@ -128,11 +134,12 @@ def run_bench(scale: float):
     n_nodes = max(1024, int(int(os.environ.get("BENCH_NODES", 2_000_000)) * scale))
     n_edges = max(4096, int(int(os.environ.get("BENCH_EDGES", 21_000_000)) * scale))
     n_seeds = max(64, int(int(os.environ.get("BENCH_SEEDS", 4096)) * min(1.0, scale * 4)))
-    iters = int(os.environ.get("BENCH_ITERS", 20))
+    iters = int(os.environ.get("BENCH_ITERS", 200))
 
     t0 = time.time()
     a = build_graph(n_nodes, n_edges)
     h_dst = np.asarray(a.dst)[: a.n_edges]
+    meta8, chunk_dst = a.chunked()
     build_s = time.time() - t0
 
     rng = np.random.default_rng(3)
@@ -140,64 +147,89 @@ def run_bench(scale: float):
         np.unique(rng.integers(1, n_nodes + 1, size=n_seeds)) for _ in range(iters)
     ]
 
-    # plan static caps from the worst case so one compilation serves all
-    def caps_for(frontier):
-        rows = frontier.copy()
-        t1 = int(a.degree_of_rows(rows).sum())
-        f1 = np.unique(np_expand(a.h_offsets, h_dst, rows))
-        t2 = int(a.degree_of_rows(f1).sum())
-        return t1, t2
-
-    worst1 = worst2 = 1
+    # plan static chunk caps from the worst case so one compilation serves all
+    worst1 = worst2 = worstu = 1
     for f in frontiers:
-        t1, t2 = caps_for(f)
-        worst1 = max(worst1, t1)
-        worst2 = max(worst2, t2)
-    cap1, cap2 = ops.bucket(worst1), ops.bucket(worst2)
+        c1 = int(a.chunk_degree_of_rows(f).sum())
+        f1 = np.unique(np_expand(a.h_offsets, h_dst, f))
+        c2 = int(a.chunk_degree_of_rows(f1).sum())
+        worst1, worst2 = max(worst1, c1), max(worst2, c2)
+        worstu = max(worstu, len(f1))
+    capc1, capc2 = ops.bucket(worst1), ops.bucket(worst2)
+    ucap = ops.bucket(worstu)  # tight row capacity for the deduped frontier
     fcap = ops.bucket(max(len(f) for f in frontiers))
 
-    # ONE device dispatch for the whole query batch.  The per-query
-    # pipeline is scatter-free (TPU scatters serialize): CSR expansion
-    # computes slot owners by binary search / prefix sum, and frontier
-    # dedup is one sort + neighbor-compare that leaves dups as skip rows
-    # (no universe-sized presence mask, no compaction).  The final result
-    # set is compacted once, outside the per-query loop.
-    def one_query(carry, frontier):
-        out1, _s1, t1 = ops.expand_csr(a.offsets, a.dst, ops.frontier_rows(frontier), cap1)
-        rows1 = ops.unique_rows_sorted(out1)
-        out2, _s2, t2 = ops.expand_csr(a.offsets, a.dst, rows1, cap2)
-        return out2, t1 + t2
+    # ONE device dispatch for the whole query batch (the axon tunnel costs
+    # ~65ms per round trip, so the batch is the unit of amortization).
+    # Per query the pipeline is the chunked expansion (ops.expand_chunked):
+    # 32-byte-granule row gathers instead of per-element scalar gathers,
+    # slot→chunk mapping by scatter+prefix-sum of per-row deltas (no owner
+    # search), and frontier dedup as one sort that leaves dups as skip
+    # rows.  vmap batches all queries into one program — no scan
+    # serialization, fixed per-op costs amortize across the batch.
+    def one_query(frontier):
+        rows0 = ops.frontier_rows(frontier)
+        out1, t1, _ = ops.expand_chunked(meta8, chunk_dst, rows0, capc1)
+        # dedup with SENT compaction, then slice to the planned unique cap:
+        # hop-2 row-level work shrinks from capc1*CHUNK to ucap
+        f1 = ops.sort_unique(out1.reshape(-1))[:ucap]
+        out2, t2, _ = ops.expand_chunked(meta8, chunk_dst, ops.frontier_rows(f1), capc2)
+        # checksum over every produced uid: forces each query's output to
+        # actually materialize (otherwise XLA could DCE all but the last
+        # query's gathers, and "edges traversed" would be a lie)
+        chk = jnp.sum(jnp.where(out2 == SENT, 0, out2), dtype=jnp.int32)
+        return chk, t1 + t2, out2
 
     @jax.jit
     def run_batch(frontiers_mat):
-        init = jnp.full((cap2,), SENT, dtype=jnp.int32)
-        last, counts = jax.lax.scan(one_query, init, frontiers_mat)
-        return counts, ops.sort_unique(last)
+        def q(frontier):
+            chk, t, _out2 = one_query(frontier)
+            return chk, t
+
+        chks, counts = jax.vmap(q)(frontiers_mat)
+        # last query's full result set for the cross-check, computed once
+        # (keeping every query's out2 as a program output would pin
+        # iters*capc2*CHUNK*4 bytes of HBM; the checksums already force
+        # materialization inside the batch)
+        _c, _t, out2_last = one_query(frontiers_mat[-1])
+        return chks, counts, ops.sort_unique(out2_last.reshape(-1))
 
     fmat = jnp.asarray(np.stack([ops.pad_to(f, fcap) for f in frontiers]))
 
-    counts, _last = run_batch(fmat)  # warmup/compile
+    chks, counts, _last = run_batch(fmat)  # warmup/compile
     np.asarray(counts)
 
-    t0 = time.time()
-    counts, last_f2 = run_batch(fmat)
-    counts = np.asarray(counts)  # sync
-    dev_s = time.time() - t0
+    dev_s = float("inf")
+    for _ in range(2):  # best-of-2, symmetric with the CPU baseline below
+        t0 = time.time()
+        chks, counts, last_f2 = run_batch(fmat)
+        counts = np.asarray(counts)  # sync
+        np.asarray(chks)
+        dev_s = min(dev_s, time.time() - t0)
     dev_edges = int(counts.sum())
 
-    t0 = time.time()
-    cpu_edges = 0
-    for f in frontiers:
-        n, _ = np_two_hop(a, h_dst, f)
-        cpu_edges += n
-    cpu_s = time.time() - t0
+    # best-of-2 for the CPU baseline: the shared host's load swings numpy
+    # throughput ~2x between runs; compare against its fastest
+    cpu_s = float("inf")
+    for _ in range(2):
+        t0 = time.time()
+        cpu_edges = 0
+        cpu_chks = []
+        for f in frontiers:
+            n, _, c = np_two_hop(a, h_dst, f)
+            cpu_edges += n
+            cpu_chks.append(c)
+        cpu_s = min(cpu_s, time.time() - t0)
 
-    # correctness cross-check on the last frontier
-    _, want = np_two_hop(a, h_dst, frontiers[-1])
+    # correctness cross-check: per-query checksums + the last frontier set
+    _, want, _ = np_two_hop(a, h_dst, frontiers[-1])
     got = np.asarray(last_f2)
     got = got[got != SENT]
     assert np.array_equal(got, want), "device 2-hop != numpy reference"
     assert dev_edges == cpu_edges, (dev_edges, cpu_edges)
+    assert np.array_equal(np.asarray(chks), np.array(cpu_chks, dtype=np.int32)), (
+        "per-query device checksums != numpy"
+    )
 
     dev_eps = dev_edges / dev_s
     cpu_eps = cpu_edges / cpu_s

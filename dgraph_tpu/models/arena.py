@@ -47,6 +47,7 @@ class CSRArena:
     h_offsets: np.ndarray           # int64[S+1]
     n_rows: int
     n_edges: int
+    _chunked: Optional[tuple] = None  # lazy (meta8, chunk_dst)
 
     def degree_of_rows(self, rows: np.ndarray) -> np.ndarray:
         """Host-side degree lookup for capacity planning."""
@@ -54,6 +55,47 @@ class CSRArena:
         ok = rows >= 0
         r = np.where(ok, rows, 0)
         return np.where(ok, self.h_offsets[r + 1] - self.h_offsets[r], 0)
+
+    def chunked(self) -> tuple:
+        """Chunk-packed layout for ops.expand_chunked, built lazily.
+
+        Returns (meta8, chunk_dst): int32[Sb, 8] per-row
+        (chunk_start, chunk_count, degree) and int32[NCb, CHUNK]
+        chunk-packed dst with SENT pad lanes.  Rebuilt with the arena on
+        dirty refresh (the tuple dies with the CSRArena object); host
+        capacity planning uses chunk_degree_of_rows.
+        """
+        if self._chunked is not None:
+            return self._chunked
+        C = ops.CHUNK
+        S = self.n_rows
+        E = self.n_edges
+        deg = self.h_offsets[1:] - self.h_offsets[:-1]
+        cdeg = (deg + C - 1) // C
+        coff = np.zeros(S + 1, dtype=np.int64)
+        np.cumsum(cdeg, out=coff[1:])
+        NC = int(coff[-1])
+        NCb = ops.bucket(max(1, NC))
+        chunk = np.full((NCb, C), SENT, dtype=np.int32)
+        if E:
+            h_dst = np.asarray(self.dst)[:E]
+            rowid = np.repeat(np.arange(S, dtype=np.int64), deg)
+            within = np.arange(E, dtype=np.int64) - np.repeat(
+                self.h_offsets[:-1], deg
+            )
+            chunk[coff[rowid] + within // C, within % C] = h_dst
+        Sb = self.offsets.shape[0] - 1
+        meta = np.zeros((Sb, 8), dtype=np.int32)
+        meta[:S, 0] = coff[:-1]
+        meta[:S, 1] = cdeg
+        meta[:S, 2] = deg
+        self._chunked = (jnp.asarray(meta), jnp.asarray(chunk))
+        return self._chunked
+
+    def chunk_degree_of_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Host chunk-count lookup (ceil(degree/CHUNK)) for planning."""
+        C = ops.CHUNK
+        return (self.degree_of_rows(rows) + C - 1) // C
 
     def rows_for_uids_host(self, uids: np.ndarray) -> np.ndarray:
         pos = np.searchsorted(self.h_src, uids)

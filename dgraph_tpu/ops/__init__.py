@@ -8,8 +8,10 @@ chasing over variable-length slices.
 """
 
 from dgraph_tpu.ops.sets import (  # noqa: F401
+    CHUNK,
     SENT,
     bucket,
+    expand_chunked,
     pad_to,
     pad_rows,
     compact,

@@ -248,6 +248,100 @@ def unique_rows_sorted(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(keep, x, -1).astype(jnp.int32)
 
 
+CHUNK = 8  # chunk width in uids: 8 × int32 = 32 bytes, one aligned granule
+
+
+@partial(jax.jit, static_argnames=("capc", "with_seg"))
+def expand_chunked(
+    meta8: jnp.ndarray,
+    chunk_dst: jnp.ndarray,
+    rows: jnp.ndarray,
+    capc: int,
+    with_seg: bool = False,
+):
+    """Chunked CSR expansion: the fast path of the posting-list gather.
+
+    Replaces expand_csr's per-element scalar gathers with per-*chunk*
+    row gathers from a [NC, CHUNK] layout (one 32-byte aligned granule per
+    index — measured ~2× cheaper per index than scalar gathers on v5e,
+    and each index fetches CHUNK uids instead of one).
+
+    The slot→chunk mapping needs no owner search at all when ``rows`` is
+    an ascending sequence of *distinct* row ids (with -1 skips anywhere —
+    exactly what sort-based dedup produces): per productive row j scatter
+    ``delta_j = chunk_start[j] - prev_productive_chunk_end[j]`` at its
+    output start, prefix-sum, add the slot iota.  Telescoping makes slot
+    i of row j read ``chunk_start[j] + (i - out_start[j])`` — the exact
+    chunk id.  One scatter + three scans + two row gathers per hop,
+    everything else elementwise.  (Replaces the reference's per-key
+    posting iteration, worker/task.go:287-440, same as expand_csr.)
+
+    Args:
+      meta8:     int32[Sb, 8] per-row metadata, lanes 0..2 =
+                 (chunk_start, chunk_count, degree); rest zero-pad.
+      chunk_dst: int32[NCb, CHUNK] chunk-packed target uids, ascending
+                 within each row, SENT in padding lanes.
+      rows:      int32[B] row ids, ascending over the valid entries, each
+                 valid row DISTINCT; -1 = skip (may appear anywhere).
+      capc:      static chunk capacity of the output.
+      with_seg:  also return seg: int32[capc] index into ``rows`` owning
+                 each chunk slot (-1 pad) — costs one extra scatter+scan.
+
+    Returns:
+      out:    int32[capc, CHUNK] target uids, SENT-padded.
+      total:  int32 — number of valid uids (true edge count).
+      seg:    int32[capc] or None (see with_seg).
+    """
+    nc = chunk_dst.shape[0]
+    valid = rows >= 0
+    r = jnp.where(valid, rows, 0)
+    m = meta8[r]  # [B, 8] one row gather
+    cs = jnp.where(valid, m[:, 0], 0)
+    cd = jnp.where(valid, m[:, 1], 0)
+    dg = jnp.where(valid, m[:, 2], 0)
+    ccum = jnp.cumsum(cd)
+    totc = ccum[-1]
+    cstart = ccum - cd
+    productive = cd > 0
+    # exclusive running max of productive rows' chunk-range ends
+    end = jnp.where(productive, cs + cd, 0)
+    pe = jnp.concatenate(
+        [jnp.zeros((1,), end.dtype), jax.lax.cummax(end)[:-1]]
+    )
+    delta = cs - pe
+    slot = jnp.where(productive, cstart, capc)
+    dvec = (
+        jnp.zeros((capc,), dtype=jnp.int32)
+        .at[slot]
+        .set(jnp.where(productive, delta, 0).astype(jnp.int32), mode="drop")
+    )
+    i = jnp.arange(capc, dtype=jnp.int32)
+    chunkid = jnp.cumsum(dvec) + i
+    ok = i < totc
+    out = chunk_dst[jnp.clip(jnp.where(ok, chunkid, 0), 0, nc - 1)]
+    out = jnp.where(ok[:, None], out, SENT)
+    total = jnp.sum(dg).astype(jnp.int32)
+    if not with_seg:
+        return out, total, None
+    # owner ordinal per slot: scatter +1 at each productive start, scan,
+    # then map ordinal -> position in ``rows`` via a second compaction
+    ivec = (
+        jnp.zeros((capc,), dtype=jnp.int32)
+        .at[slot]
+        .set(1, mode="drop")
+    )
+    k = jnp.cumsum(ivec) - 1  # ordinal among productive rows
+    k_row = jnp.cumsum(productive.astype(jnp.int32)) - 1
+    nrows = rows.shape[0]
+    pos_of_ord = (
+        jnp.zeros((nrows,), dtype=jnp.int32)
+        .at[jnp.where(productive, k_row, nrows)]
+        .set(jnp.arange(nrows, dtype=jnp.int32), mode="drop")
+    )
+    seg = pos_of_ord[jnp.clip(k, 0, nrows - 1)]
+    return out, total, jnp.where(ok, seg, -1)
+
+
 @jax.jit
 def frontier_rows(f: jnp.ndarray) -> jnp.ndarray:
     """Frontier uids → row indices for a *dense* arena (row i == uid i):

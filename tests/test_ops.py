@@ -163,3 +163,89 @@ def test_unique_rows_sorted():
         assert np.array_equal(kept, np.unique(vals))
         # valid entries ascend in place; everything else is the skip row
         assert set(got.tolist()) - set(kept.tolist()) == ({-1} if (cap > n or len(kept) < n) else set())
+
+
+def test_expand_chunked(rng):
+    """Chunked expansion == element-level reference, incl. seg owners.
+
+    Rows must be ascending-distinct with -1 skips (the contract the
+    kernel's telescoping construction relies on; see ops/sets.py).
+    """
+    from dgraph_tpu.models.arena import csr_from_edges
+
+    for trial in range(15):
+        n_src = int(rng.integers(1, 40))
+        n_edges = int(rng.integers(0, 300))
+        src = rng.integers(0, n_src, size=n_edges)
+        dst = rng.integers(0, 500, size=n_edges)
+        a = csr_from_edges(src, dst)
+        meta8, chunk_dst = a.chunked()
+        # ascending distinct rows with -1 skips sprinkled in
+        nrows = a.n_rows
+        pick = np.unique(rng.integers(0, max(1, nrows), size=rng.integers(0, 8)))
+        pick = pick[pick < nrows]
+        rows = []
+        for r in pick:
+            if rng.random() < 0.3:
+                rows.append(-1)
+            rows.append(r)
+        rows = np.array(rows + [-1] * int(rng.integers(0, 3)), dtype=np.int32)
+        B = ops.bucket(max(1, len(rows)))
+        rows_p = np.full(B, -1, dtype=np.int32)
+        rows_p[: len(rows)] = rows
+        want = ref.expand_csr(
+            a.h_offsets.astype(np.int32),
+            np.asarray(a.dst)[: a.n_edges],
+            rows,
+        )
+        capc = ops.bucket(int(a.chunk_degree_of_rows(rows).sum()) or 1)
+        out, total, seg = ops.expand_chunked(meta8, chunk_dst, rows_p, capc, with_seg=True)
+        out, seg = np.asarray(out), np.asarray(seg)
+        assert int(total) == len(want)
+        flat = out.reshape(-1)
+        np.testing.assert_array_equal(np.sort(flat[flat != SENT]), np.sort(want))
+        # per-slot owners: expand each chunk-slot owner to its valid lanes
+        lane_owner = np.repeat(seg, ops.CHUNK)
+        valid = flat != SENT
+        want_seg = np.concatenate(
+            [
+                np.full(int(a.h_offsets[r + 1] - a.h_offsets[r]), i)
+                for i, r in enumerate(rows_p)
+                if r >= 0
+            ]
+            or [np.empty(0, dtype=np.int64)]
+        )
+        # group uids by owner and compare as multisets per owner
+        got_pairs = sorted(zip(lane_owner[valid].tolist(), flat[valid].tolist()))
+        want_pairs = sorted(zip(want_seg.tolist(), want.tolist()))
+        assert got_pairs == want_pairs
+
+
+def test_expand_chunked_two_hop_matches_scalar(rng):
+    """Whole 2-hop chunked pipeline == numpy unique/expand semantics."""
+    from dgraph_tpu.models.arena import csr_dense_from_edges
+
+    n_nodes = 200
+    src = rng.integers(1, n_nodes + 1, size=2000)
+    dst = rng.integers(1, n_nodes + 1, size=2000)
+    a = csr_dense_from_edges(src, dst, n_nodes)
+    meta8, chunk_dst = a.chunked()
+    h_dst = np.asarray(a.dst)[: a.n_edges]
+    frontier = np.unique(rng.integers(1, n_nodes + 1, size=30))
+
+    out1 = ref.expand_csr(a.h_offsets.astype(np.int32), h_dst, frontier)
+    f1 = np.unique(out1)
+    out2 = ref.expand_csr(a.h_offsets.astype(np.int32), h_dst, f1)
+    want_edges = len(out1) + len(out2)
+
+    fcap = ops.bucket(len(frontier))
+    capc1 = ops.bucket(int(a.chunk_degree_of_rows(frontier).sum()) or 1)
+    capc2 = ops.bucket(int(a.chunk_degree_of_rows(f1).sum()) or 1)
+    rows0 = ops.frontier_rows(ops.pad_to(frontier, fcap))
+    o1, t1, _ = ops.expand_chunked(meta8, chunk_dst, rows0, capc1)
+    rows1 = ops.unique_rows_sorted(o1.reshape(-1))
+    o2, t2, _ = ops.expand_chunked(meta8, chunk_dst, rows1, capc2)
+    assert int(t1) + int(t2) == want_edges
+    flat = np.asarray(ops.sort_unique(o2.reshape(-1)))
+    got = flat[flat != SENT]
+    np.testing.assert_array_equal(got, np.unique(out2))
