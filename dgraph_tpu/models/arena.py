@@ -56,6 +56,47 @@ class CSRArena:
         r = np.where(ok, rows, 0)
         return np.where(ok, self.h_offsets[r + 1] - self.h_offsets[r], 0)
 
+    _h_dst: Optional[np.ndarray] = None
+    _n_distinct_dst: Optional[int] = None
+
+    def host_dst(self) -> np.ndarray:
+        """Host mirror of the packed dst column (lazy, cached; one device
+        fetch).  Serves the small-expansion numpy fast path and chunked()."""
+        if self._h_dst is None:
+            self._h_dst = np.asarray(self.dst)[: self.n_edges]
+        return self._h_dst
+
+    def n_distinct_dst(self) -> int:
+        """Number of distinct target uids (lazy).  Bounds the unique
+        frontier any expansion over this arena can produce — unlike the
+        source-uid universe, which says nothing about row-less leaves."""
+        if self._n_distinct_dst is None:
+            self._n_distinct_dst = (
+                int(len(np.unique(self.host_dst()))) if self.n_edges else 0
+            )
+        return self._n_distinct_dst
+
+    def expand_host(self, rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorized numpy CSR expansion over the host mirror: returns
+        (out, seg_ptr) in the engine's layout — out grouped by input row
+        (ascending within each group), seg_ptr[i]:seg_ptr[i+1] slicing row
+        i's targets.  Rows < 0 skip (degree 0).  The single host gather
+        shared by the engine's and the resolver's small-expansion paths."""
+        rows = np.asarray(rows)
+        n = len(rows)
+        ok = rows >= 0
+        r = np.where(ok, rows, 0)
+        degs = np.where(ok, self.h_offsets[r + 1] - self.h_offsets[r], 0)
+        total = int(degs.sum())
+        seg_ptr = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(degs, out=seg_ptr[1:])
+        if total == 0:
+            return np.empty(0, dtype=np.int64), seg_ptr
+        starts = np.where(ok, self.h_offsets[r], 0)
+        within = np.arange(total) - np.repeat(seg_ptr[:-1], degs)
+        out = self.host_dst()[np.repeat(starts, degs) + within].astype(np.int64)
+        return out, seg_ptr
+
     def chunked(self) -> tuple:
         """Chunk-packed layout for ops.expand_chunked, built lazily.
 
@@ -78,7 +119,7 @@ class CSRArena:
         NCb = ops.bucket(max(1, NC))
         chunk = np.full((NCb, C), SENT, dtype=np.int32)
         if E:
-            h_dst = np.asarray(self.dst)[:E]
+            h_dst = self.host_dst()
             rowid = np.repeat(np.arange(S, dtype=np.int64), deg)
             within = np.arange(E, dtype=np.int64) - np.repeat(
                 self.h_offsets[:-1], deg
@@ -96,6 +137,23 @@ class CSRArena:
         """Host chunk-count lookup (ceil(degree/CHUNK)) for planning."""
         C = ops.CHUNK
         return (self.degree_of_rows(rows) + C - 1) // C
+
+    _lut: Optional[jnp.ndarray] = None
+
+    def lut(self, universe: int) -> jnp.ndarray:
+        """Dense uid→row lookup table on device: int32[bucket(universe+1)],
+        -1 where the uid has no row.  One elementwise gather replaces a
+        device binary search (searchsorted costs log(S) gather rounds —
+        measured ~20× slower at engine scales).  ~4 bytes/uid of HBM."""
+        need = ops.bucket(max(1, universe + 1))
+        if self._lut is not None and self._lut.shape[0] >= need:
+            return self._lut
+        t = np.full(need, -1, dtype=np.int32)
+        if self.n_rows:
+            keys = self.h_src[self.h_src <= universe]
+            t[keys] = np.arange(len(keys), dtype=np.int32)
+        self._lut = jnp.asarray(t)
+        return self._lut
 
     def rows_for_uids_host(self, uids: np.ndarray) -> np.ndarray:
         pos = np.searchsorted(self.h_src, uids)
@@ -251,6 +309,7 @@ class ValueArena:
                                     # padding slots hold -1
     h_src: np.ndarray               # int64[S]
     h_vals: np.ndarray              # float64[S]
+    h_ranks: np.ndarray             # int32[S] host mirror of ranks (exact)
     n: int
     langless: bool = True           # no lang-tagged values existed for the
                                     # predicate — untagged host lookup and
@@ -271,6 +330,14 @@ class ArenaManager:
         # None = single-device execution
         self.mesh = mesh
         self.shard_threshold = shard_threshold
+        # single source of truth for host-vs-device expansion routing
+        # (engine and FuncResolver both read it; engine may retune at
+        # runtime) — see QueryEngine.__init__ for the rationale
+        import os as _os
+
+        self.expand_device_min = int(
+            _os.environ.get("DGRAPH_TPU_EXPAND_DEVICE_MIN", 262144)
+        )
         self._data: Dict[str, CSRArena] = {}
         self._reverse: Dict[str, CSRArena] = {}
         self._index: Dict[Tuple[str, str], IndexArena] = {}
@@ -453,6 +520,7 @@ class ArenaManager:
                 ranks=jnp.asarray(rk),
                 h_src=uids,
                 h_vals=vals,
+                h_ranks=rk[:S].copy(),
                 n=S,
                 langless=langless,
             )

@@ -1,0 +1,267 @@
+"""Fused multi-level uid-chain execution: the engine's device fast path.
+
+The per-level engine (`QueryEngine._expand`) pays one device dispatch and
+one host round trip per (level × predicate) — the host↔device ping-pong
+the reference pays as per-key badger lookups (worker/task.go:287-440) and
+that VERDICT r2 flagged as the engine's bottleneck.  This module fuses a
+maximal chain of uid expansions into ONE jitted program: the frontier
+stays device-resident between levels (rows via a dense uid→row LUT,
+expansion via ops.expand_chunked, dedup via sort), and only the final
+per-level result matrices transfer to the host for filtering-free levels'
+JSON encoding.
+
+Eligibility (per level): plain uid expansion — no count, no filter, no
+facets, no order/pagination, no groupby, no var-func — i.e. the shape of
+the reference's hot film queries (wiki/content/performance/index.md:32).
+Anything else falls back to the per-level path, which remains the
+general-correctness implementation.
+
+Capacity planning is overflow-free: level-0 caps are exact (host degree
+lookup on the root frontier); deeper caps use the arena's top-m chunk
+degree cumsum (the sum of the m largest rows bounds any m-row frontier).
+If a planned cap exceeds CHAIN_MAX_CAPC the chain is abandoned before
+compile (memory guard), never mid-query.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgraph_tpu import ops
+from dgraph_tpu.ops.sets import SENT
+
+# minimum estimated level-0 fan-out before fusing pays for itself.
+# Matches DGRAPH_TPU_EXPAND_DEVICE_MIN by design: once individual levels
+# would dispatch to the device anyway, one fused dispatch strictly beats
+# one per level; below it, host numpy wins on transport latency.
+CHAIN_THRESHOLD = int(os.environ.get("DGRAPH_TPU_CHAIN_THRESHOLD", 262144))
+# abandon plans whose per-level output would exceed this many chunks
+CHAIN_MAX_CAPC = int(os.environ.get("DGRAPH_TPU_CHAIN_MAX_CAPC", 1 << 21))
+
+
+def eligible_level(engine, sg) -> bool:
+    """Is this SubGraph a fusable plain uid expansion?"""
+    p = sg.params
+    if sg.attr in ("", "_uid_", "uid", "val", "math", "_predicate_"):
+        return False
+    if sg.func is not None or sg.filter is not None:
+        return False
+    if p.do_count or p.is_groupby or p.expand:
+        return False
+    if p.facets is not None or p.facets_filter is not None:
+        return False
+    if p.order_attr or p.first or p.offset or p.after:
+        return False
+    tid = engine.store.schema.type_of(sg.attr)
+    from dgraph_tpu.models.types import TypeID
+
+    pd = engine.store.peek(sg.attr)
+    is_uid = tid == TypeID.UID or (pd is not None and bool(pd.edges))
+    return bool(is_uid)
+
+
+def collect_chain(engine, child) -> List:
+    """Maximal fusable chain starting at ``child`` (itself eligible)."""
+    levels = [child]
+    node = child
+    while True:
+        nxt = [c for c in node.children if eligible_level(engine, c)]
+        if len(nxt) != 1:
+            break
+        levels.append(nxt[0])
+        node = nxt[0]
+    return levels
+
+
+@partial(jax.jit, static_argnames=("caps", "light"))
+def _run_fused(root_vec, metas, cdsts, luts, caps, light=False):
+    """One program for the whole chain, ONE packed output buffer.
+
+    root_vec: int32[cap_u0] sorted-unique uids, SENT-padded.
+    metas/cdsts/luts: tuples of per-level arena arrays.
+    caps: static tuple of (capc_i, cap_u_i) per level; cap_u_i bounds the
+      deduped frontier fed to level i+1.
+    light: var-block mode — no result matrices needed (nothing will be
+      JSON-encoded), so per level only the edge count and, where a var or
+      sibling subtree consumes it on the host (caps[i][2]), the deduped
+      frontier transfer: 10-100× less traffic on big fan-outs.
+
+    Everything returns as a single concatenated int32 vector (layout per
+    level: [out2d.ravel | seg | nxt] | [nxt if needed] | total) — each
+    device→host fetch pays the transport round trip separately, so the
+    whole chain transfers once.
+    """
+    u = root_vec
+    parts = []
+    for i in range(len(metas)):
+        capc, cap_u, need_dest = caps[i]
+        lut = luts[i]
+        rows = jnp.where(
+            (u >= 0) & (u < lut.shape[0]) & (u != SENT),
+            lut[jnp.clip(u, 0, lut.shape[0] - 1)],
+            -1,
+        )
+        out2d, total, seg = ops.expand_chunked(
+            metas[i], cdsts[i], rows, capc, with_seg=not light
+        )
+        nxt = ops.sort_unique(out2d.reshape(-1))[:cap_u]
+        if not light:
+            parts += [out2d.reshape(-1), seg, nxt, total.reshape(1)]
+        elif need_dest:
+            parts += [nxt, total.reshape(1)]
+        else:
+            parts += [total.reshape(1)]
+        u = nxt
+    return jnp.concatenate(parts)
+
+
+def try_run_chain(engine, child, src: np.ndarray) -> bool:
+    """Attempt fused execution of the chain rooted at ``child`` with
+    frontier ``src``.  On success, stages (out_flat, seg_ptr) on every
+    chain level (chain_stash) and returns True; on ineligibility returns
+    False and the caller uses the per-level path."""
+    if len(src) == 0 or not eligible_level(engine, child):
+        return False
+    src = np.asarray(src)
+    if not np.all(src[1:] > src[:-1]):
+        # expand_chunked's slot mapping requires an ascending-distinct
+        # frontier; an order-by at the root permutes dest_uids, so fusing
+        # would corrupt the matrices — fall back
+        return False
+    levels = collect_chain(engine, child)
+    if len(levels) < 2:
+        return False
+    arenas = []
+    universe = 0
+    for sg in levels:
+        a = (
+            engine.arenas.reverse(sg.attr)
+            if sg.reverse
+            else engine.arenas.data(sg.attr)
+        )
+        if a.n_edges == 0 or engine.arenas.use_mesh_for(a):
+            break  # truncate the chain here; the tail runs per-level
+        arenas.append(a)
+        if a.n_rows:
+            # any uid owning a row in some chain arena is ≤ this bound, so
+            # LUT misses beyond it are exactly the row-less uids
+            universe = max(universe, int(a.h_src[-1]))
+    levels = levels[: len(arenas)]
+    if len(levels) < 2:
+        return False
+
+    # --- capacity planning (overflow-free) ---
+    rows0 = arenas[0].rows_for_uids_host(src)
+    est_edges = int(arenas[0].degree_of_rows(rows0).sum())
+    # whole-chain fan-out estimate: propagate by average out-degree so a
+    # modest first level doesn't hide a multi-million-edge tail
+    est_total = est_u = est_edges
+    for a in arenas[1:]:
+        est_u = min(est_u, a.n_rows)
+        lvl = int(est_u * (a.n_edges / max(1, a.n_rows)))
+        est_total += lvl
+        est_u = lvl
+    if est_total < engine.chain_threshold:
+        return False
+    caps: List[Tuple[int, int, bool]] = []
+    m = len(src)  # bound on the unique frontier entering each level
+    for i, a in enumerate(arenas):
+        if i == 0:
+            capc = int(arenas[0].chunk_degree_of_rows(rows0).sum())
+        else:
+            capc = int(_topm_chunk_sum(a, m))
+        capc = ops.bucket(max(1, capc))
+        if capc > CHAIN_MAX_CAPC:
+            return False
+        # unique next-frontier ≤ total output slots, ≤ the arena's distinct
+        # target count (NOT the source-uid universe: row-less leaf uids
+        # exceed it, and truncating them would corrupt light-mode dest
+        # sets and var bindings)
+        nd = max(1, a.n_distinct_dst())
+        cap_u = ops.bucket(max(1, min(capc * ops.CHUNK, nd)))
+        sg = levels[i]
+        # does anything on the host consume this level's dest set?
+        need_dest = (
+            bool(sg.params.var)
+            or len(sg.children) > 1
+            or i == len(levels) - 1
+        )
+        caps.append((capc, cap_u, need_dest))
+        m = min(capc * ops.CHUNK, nd)
+
+    metas, cdsts, luts = [], [], []
+    for a in arenas:
+        m8, cd = a.chunked()
+        metas.append(m8)
+        cdsts.append(cd)
+        luts.append(a.lut(universe))
+
+    # var blocks encode nothing, so result matrices never leave the device
+    # (unless a level participates in @cascade, which prunes matrices)
+    light = bool(
+        getattr(engine, "_cur_block_internal", False)
+        and not any(sg.params.cascade for sg in levels)
+    )
+
+    root_vec = jnp.asarray(ops.pad_to(src, ops.bucket(max(1, len(src)))))
+    packed = np.asarray(  # ONE device round trip for the whole chain
+        _run_fused(
+            root_vec, tuple(metas), tuple(cdsts), tuple(luts), tuple(caps),
+            light=light,
+        )
+    )
+
+    # --- host conversion: packed buffer → engine results per level ---
+    src_list = np.asarray(src, dtype=np.int64)
+    pos = 0
+    for sg, (capc, cap_u, need_dest) in zip(levels, caps):
+        if light:
+            dest = None
+            if need_dest:
+                nxt = packed[pos : pos + cap_u]
+                pos += cap_u
+                dest = nxt[nxt != SENT].astype(np.int64)
+            total = int(packed[pos])
+            pos += 1
+            # src_list None = "trusted": the previous level's dest stayed
+            # on device, so the consumer skips the alignment check
+            sg.chain_stash = ("light", dest, src_list, total)
+            src_list = dest
+            continue
+        flat = packed[pos : pos + capc * ops.CHUNK]
+        pos += capc * ops.CHUNK
+        seg = packed[pos : pos + capc]
+        pos += capc
+        nxt = packed[pos : pos + cap_u]
+        pos += cap_u
+        pos += 1  # total (unused in full mode: lengths say it)
+        owner = np.repeat(seg, ops.CHUNK)
+        valid = flat != SENT
+        out_flat = flat[valid].astype(np.int64)
+        owner = owner[valid]
+        n_src = len(src_list)
+        counts = np.bincount(owner, minlength=n_src)[:n_src]
+        seg_ptr = np.zeros(n_src + 1, dtype=np.int64)
+        np.cumsum(counts, out=seg_ptr[1:])
+        sg.chain_stash = ("full", out_flat, seg_ptr, src_list)
+        src_list = nxt[nxt != SENT].astype(np.int64)
+    return True
+
+
+def _topm_chunk_sum(arena, m: int) -> int:
+    """Upper bound on the chunk-degree sum of ANY m distinct rows: the
+    cumsum of the descending-sorted per-row chunk degrees (cached)."""
+    cs = getattr(arena, "_topm_cdeg", None)
+    if cs is None:
+        C = ops.CHUNK
+        deg = arena.h_offsets[1:] - arena.h_offsets[:-1]
+        cdeg = np.sort((deg + C - 1) // C)[::-1]
+        cs = np.concatenate([[0], np.cumsum(cdeg)])
+        arena._topm_cdeg = cs
+    return int(cs[min(m, len(cs) - 1)])

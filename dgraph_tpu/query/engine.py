@@ -12,6 +12,7 @@ reference draws at the ServeTask boundary (SURVEY.md §2c).
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -28,6 +29,26 @@ from dgraph_tpu.query import outputnode
 _EMPTY = np.empty(0, dtype=np.int64)
 
 
+def _make_packed_expand():
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnames=("cap",))
+    def run(offsets, dst, rows, cap):
+        out, seg, _t = ops.expand_csr(offsets, dst, rows, cap)
+        return jnp.concatenate([out, seg])
+
+    return run
+
+
+# expand_csr with (out | seg) concatenated on device: one host fetch
+# instead of two (each fetch pays a full transport round trip).
+# Module-level so the jit cache persists across queries.
+_packed_expand_csr = _make_packed_expand()
+
+
 class QueryEngine:
     """One engine instance per store; thread-unsafe by design (the serving
     layer serializes, as the reference does per-request goroutines over
@@ -36,6 +57,28 @@ class QueryEngine:
     def __init__(self, store: PostingStore, mesh=None, shard_threshold: int = 4096):
         self.store = store
         self.arenas = ArenaManager(store, mesh=mesh, shard_threshold=shard_threshold)
+        from dgraph_tpu.query.chain import CHAIN_THRESHOLD
+
+        # minimum estimated fan-out before chains fuse into one device
+        # program (below it, per-level host orchestration wins on latency)
+        self.chain_threshold = CHAIN_THRESHOLD
+        # below this fan-out an expansion runs as vectorized numpy on the
+        # host CSR mirror: a device dispatch pays a transport round trip
+        # (~130ms through the axon tunnel, ~100µs co-located) that only
+        # amortizes on big gathers.  Same adaptive-by-size philosophy as
+        # the reference's intersection-algorithm choice (uidlist.go:56-64).
+        # Stored on the ArenaManager so FuncResolver shares the policy.
+        # per-request execution stats (reset by run_parsed): edge traversal
+        # counts feed bench_engine and the /debug latency map
+        self.stats = {"edges": 0, "chain_fused_levels": 0}
+
+    @property
+    def expand_device_min(self) -> int:
+        return self.arenas.expand_device_min
+
+    @expand_device_min.setter
+    def expand_device_min(self, v: int) -> None:
+        self.arenas.expand_device_min = v
 
     # -- public ------------------------------------------------------------
 
@@ -47,6 +90,7 @@ class QueryEngine:
     def run_parsed(self, parsed: "gql.ParsedResult") -> dict:
         """Execute an already-parsed request — the single request pipeline
         shared by the embedded path (run) and the HTTP server."""
+        self.stats = {"edges": 0, "chain_fused_levels": 0}
         out: dict = {}
         if parsed.mutation is not None:
             from dgraph_tpu.serve.mutations import (
@@ -114,6 +158,9 @@ class QueryEngine:
 
     def _exec_block(self, sg: SubGraph, uid_vars, value_vars):
         resolver = FuncResolver(self.store, self.arenas, uid_vars, value_vars)
+        # var blocks are never encoded → chains under them may skip result
+        # matrices entirely (light mode, query/chain.py)
+        self._cur_block_internal = bool(sg.params.is_internal)
         if sg.params.is_shortest:
             from dgraph_tpu.query.shortest import shortest_path
 
@@ -340,9 +387,44 @@ class QueryEngine:
                 }
             return
 
-        # uid expansion on device
-        arena = self.arenas.reverse(attr) if child.reverse else self.arenas.data(attr)
-        out_flat, seg_ptr = self._expand(arena, src, attr=attr, reverse=child.reverse)
+        # uid expansion on device.  Big plain chains fuse into one device
+        # program (query/chain.py) staged here and consumed level by level
+        # as the recursion descends; everything else goes per-level.
+        if child.chain_stash is None:
+            from dgraph_tpu.query.chain import try_run_chain
+
+            try_run_chain(self, child, src)
+        if child.chain_stash is not None and child.chain_stash[0] == "light":
+            _tag, dest, stash_src, n_edges = child.chain_stash
+            child.chain_stash = None
+            if stash_src is None or len(stash_src) == len(src):
+                # var-block level: matrices stayed on device; only the
+                # deduped frontier came back (and only where a var or a
+                # sibling subtree consumes it — dest None otherwise)
+                child.src_uids = src
+                child.out_flat = _EMPTY
+                child.seg_ptr = np.zeros(len(src) + 1, dtype=np.int64)
+                child.dest_uids = dest if dest is not None else _EMPTY
+                self.stats["edges"] += n_edges
+                self.stats["chain_fused_levels"] += 1
+                self._exec_children(child, resolver, uid_vars, value_vars)
+                return
+        if child.chain_stash is not None:
+            _tag, out_flat, seg_ptr, stash_src = child.chain_stash
+            child.chain_stash = None
+            if len(stash_src) != len(src):  # defensive: never mis-align
+                arena = (
+                    self.arenas.reverse(attr) if child.reverse else self.arenas.data(attr)
+                )
+                out_flat, seg_ptr = self._expand(
+                    arena, src, attr=attr, reverse=child.reverse
+                )
+            else:
+                self.stats["edges"] += len(out_flat)
+                self.stats["chain_fused_levels"] += 1
+        else:
+            arena = self.arenas.reverse(attr) if child.reverse else self.arenas.data(attr)
+            out_flat, seg_ptr = self._expand(arena, src, attr=attr, reverse=child.reverse)
         child.src_uids = src
         child.out_flat = out_flat
         child.seg_ptr = seg_ptr
@@ -385,14 +467,25 @@ class QueryEngine:
 
             sharded = self.arenas.sharded_csr(attr, reverse=reverse)
             return sharded_expand_segments(self.arenas.mesh, sharded, src, cap)
-        out, seg, _t = ops.expand_csr(
-            arena.offsets, arena.dst, ops.pad_rows(rows, ops.bucket(n)), cap
+        if total < self.expand_device_min:
+            # small expansion: vectorized numpy over the host CSR mirror —
+            # a device dispatch costs a transport round trip that dwarfs
+            # the work (the size-adaptive routing the reference does
+            # per-intersection, algo/uidlist.go:56-64, done per-level)
+            out, seg_ptr = arena.expand_host(rows)
+            self.stats["edges"] += len(out)
+            return out, seg_ptr
+        packed = np.asarray(  # one fetch: out|seg concatenated on device
+            _packed_expand_csr(
+                arena.offsets, arena.dst, ops.pad_rows(rows, ops.bucket(n)), cap
+            )
         )
-        out = np.asarray(out[:total], dtype=np.int64)
-        seg = np.asarray(seg[:total], dtype=np.int64)
+        out = packed[:total].astype(np.int64)
+        seg = packed[cap : cap + total].astype(np.int64)
         counts = np.bincount(seg, minlength=n)
         seg_ptr = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(counts, out=seg_ptr[1:])
+        self.stats["edges"] += len(out)
         return out, seg_ptr
 
     # -- filters -----------------------------------------------------------
@@ -538,6 +631,21 @@ class QueryEngine:
         n = len(out)
         if n == 0:
             return np.empty(0, dtype=np.int64)
+        if n < self.expand_device_min:
+            # small sorts: numpy lexsort over the host rank mirror beats a
+            # device round trip (same size routing as _expand); missing
+            # values sort last ascending / first descending, matching the
+            # device kernel (ops/order.py segmented_sort_perm)
+            miss = np.int64(1) << 40
+            if va.n:
+                pos = np.clip(np.searchsorted(va.h_src, out), 0, va.n - 1)
+                hit = va.h_src[pos] == out
+                key = np.where(hit, va.h_ranks[pos].astype(np.int64), miss)
+            else:
+                key = np.full(n, miss, dtype=np.int64)
+            if desc:
+                key = np.where(key == miss, -miss, -key)
+            return np.lexsort((key, owner)).astype(np.int64)
         import jax.numpy as jnp
 
         cap = ops.bucket(n)

@@ -30,7 +30,6 @@ from dgraph_tpu.models.types import (
 )
 from dgraph_tpu.gql.ast import Function
 
-
 class QueryError(ValueError):
     pass
 
@@ -109,7 +108,8 @@ class FuncResolver:
         return np.intersect1d(uids, candidates)
 
     def _expand_rows(self, arena, rows: np.ndarray) -> np.ndarray:
-        """Union of the posting lists at ``rows`` (device expand + unique)."""
+        """Union of the posting lists at ``rows`` (expand + unique),
+        size-routed host/device like QueryEngine._expand."""
         rows = np.asarray(rows, dtype=np.int64)
         rows = rows[rows >= 0]
         if rows.size == 0 or arena.n_edges == 0:
@@ -117,6 +117,9 @@ class FuncResolver:
         total = int(arena.degree_of_rows(rows).sum())
         if total == 0:
             return _EMPTY
+        if total < self.arenas.expand_device_min:
+            out, _seg = arena.expand_host(rows)
+            return np.unique(out)
         cap = ops.bucket(total)
         out, _seg, _t = ops.expand_csr(
             arena.offsets, arena.dst, ops.pad_rows(rows, ops.bucket(len(rows))), cap

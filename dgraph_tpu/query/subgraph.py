@@ -74,6 +74,9 @@ class SubGraph:
     value_facets: Dict[int, Dict[str, Any]] = field(default_factory=dict)
     groups: Optional[List[dict]] = None          # groupby results
     reverse: bool = False                        # ~pred expansion
+    # fused-chain results staged by query/chain.py for this node, consumed
+    # by the engine instead of a per-level _expand: (out_flat, seg_ptr)
+    chain_stash: Optional[Tuple[np.ndarray, np.ndarray]] = None
 
     def row_targets(self, i: int) -> np.ndarray:
         return self.out_flat[self.seg_ptr[i] : self.seg_ptr[i + 1]]

@@ -1,0 +1,148 @@
+"""Fused-chain execution == per-level execution.
+
+The chain fast path (query/chain.py) must be invisible: identical JSON
+for any eligible query, falling back cleanly where ineligible.  Random
+multi-level graphs + the film shapes, run with the threshold forced to 0
+(fuse everything fusable) and compared against the per-level engine.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.models import PostingStore
+from dgraph_tpu.query import QueryEngine
+
+SCHEMA = """
+    name: string @index(exact) .
+    knows: uid @reverse .
+    likes: uid .
+    boss: uid .
+"""
+
+
+def build_engine(seed: int, n: int = 60, threshold: int = 0) -> QueryEngine:
+    rng = np.random.default_rng(seed)
+    lines = []
+    for u in range(1, n + 1):
+        lines.append(f'<0x{u:x}> <name> "P{u}" .')
+        for pred, fan in (("knows", 4), ("likes", 3), ("boss", 1)):
+            for v in rng.integers(1, n + 1, size=rng.integers(0, fan + 1)):
+                lines.append(f"<0x{u:x}> <{pred}> <0x{int(v):x}> .")
+    st = PostingStore()
+    eng = QueryEngine(st)
+    eng.run("mutation { schema { %s } }" % SCHEMA)
+    eng.run("mutation { set { %s } }" % "\n".join(lines))
+    eng.chain_threshold = threshold
+    return eng
+
+
+QUERIES = [
+    # 3-level plain chain
+    '{ q(func: eq(name, "P1")) { knows { likes { boss { name } } } } }',
+    # chain with value-leaf siblings at every level
+    '{ q(func: eq(name, "P2")) { name knows { name likes { name boss { name } } } } }',
+    # reverse edges in the chain
+    '{ q(func: eq(name, "P3")) { ~knows { knows { name } } } }',
+    # ineligible middle (filter) — must fall back and still be correct
+    '{ q(func: eq(name, "P1")) { knows { likes @filter(eq(name, "P5")) { name } } } }',
+    # pagination at a level — ineligible, falls back
+    '{ q(func: eq(name, "P1")) { knows (first: 2) { likes { name } } } }',
+    # var binding along a chain
+    '{ q(func: eq(name, "P4")) { x as knows { likes { name } } } '
+    '  r(func: uid(x)) { name } }',
+    # count leaf below a chain
+    '{ q(func: eq(name, "P6")) { knows { likes { count(boss) } } } }',
+    # internal var block: chain runs in light mode (no matrices transfer)
+    '{ var(func: eq(name, "P1")) { knows { likes { y as boss } } } '
+    '  r(func: uid(y)) { name } }',
+    # var bound mid-chain in a var block
+    '{ var(func: eq(name, "P2")) { m as knows { likes { boss } } } '
+    '  r(func: uid(m)) { name } }',
+    # cascade inside a var block forces full mode; results must not change
+    '{ var(func: eq(name, "P3")) @cascade { knows { c as likes { boss } } } '
+    '  r(func: uid(c)) { name } }',
+    # ordered root frontier: permuted dest_uids must NOT fuse (the kernel
+    # needs ascending rows); results must match the per-level path
+    '{ q(func: has(knows), orderdesc: name, first: 5) { knows { likes { name } } } }',
+]
+
+
+@pytest.mark.parametrize("qi", range(len(QUERIES)))
+@pytest.mark.parametrize("seed", [1, 2])
+def test_chain_matches_per_level(qi, seed):
+    q = QUERIES[qi]
+    fused = build_engine(seed, threshold=0)
+    plain = build_engine(seed, threshold=10**18)
+    got = fused.run(q)
+    want = plain.run(q)
+    assert json.dumps(got, sort_keys=True, default=str) == json.dumps(
+        want, sort_keys=True, default=str
+    )
+
+
+def test_chain_actually_fuses():
+    """The fast path must really execute (guard against silent fallback)."""
+    from dgraph_tpu.query import chain as chain_mod
+
+    eng = build_engine(3, threshold=0)
+    calls = []
+    orig = chain_mod.try_run_chain
+
+    def spy(engine, child, src):
+        r = orig(engine, child, src)
+        calls.append((child.attr, r))
+        return r
+
+    chain_mod.try_run_chain = spy
+    try:
+        eng.run('{ q(func: eq(name, "P1")) { knows { likes { boss { name } } } } }')
+    finally:
+        chain_mod.try_run_chain = orig
+    assert any(ok for _a, ok in calls), calls
+
+
+def test_chain_deep_and_empty_levels():
+    """Chains that dead-end mid-way (empty tail predicate) stay correct."""
+    def mk(threshold):
+        st = PostingStore()
+        eng = QueryEngine(st)
+        eng.run("mutation { schema { %s } }" % SCHEMA)
+        eng.run(
+            'mutation { set { <0x1> <name> "A" . <0x1> <knows> <0x2> . '
+            "<0x2> <likes> <0x3> . } }"
+        )
+        eng.chain_threshold = threshold
+        return eng
+
+    q = '{ q(func: eq(name, "A")) { knows { likes { boss { name } } } } }'
+    got = mk(0).run(q)
+    want = mk(10**18).run(q)
+    assert json.dumps(got, sort_keys=True, default=str) == json.dumps(
+        want, sort_keys=True, default=str
+    )
+
+
+def test_light_mode_keeps_rowless_leaf_uids():
+    """Light-mode dest sets must include leaf uids beyond every chain
+    arena's source range (regression: cap_u was bounded by the source-uid
+    universe, silently truncating row-less leaves out of var bindings)."""
+    st = PostingStore()
+    eng = QueryEngine(st)
+    eng.run("mutation { schema { %s } }" % SCHEMA)
+    lines = ['<0x1> <name> "root" .']
+    # mid level: uids 2..9; leaves: 0x1000+ (all above any source uid)
+    for mid in range(2, 10):
+        lines.append(f"<0x1> <knows> <0x{mid:x}> .")
+        for leaf in range(4):
+            lines.append(f"<0x{mid:x}> <likes> <0x{0x1000 + mid * 8 + leaf:x}> .")
+    eng.run("mutation { set { %s } }" % "\n".join(lines))
+    eng.chain_threshold = 0
+    out = eng.run(
+        '{ var(func: eq(name, "root")) { knows { L as likes } } '
+        "  r(func: uid(L)) { _uid_ } }"
+    )
+    got = sorted(int(x["_uid_"], 16) for x in out["r"])
+    want = sorted({0x1000 + m * 8 + l for m in range(2, 10) for l in range(4)})
+    assert got == want
