@@ -83,15 +83,15 @@ def test_unmarshal_nested():
         friend: List[Friend] = field(default_factory=list)
 
     node = {
-        "name": "Michonne",
-        "age": 38,
+        "name": "Noor Haddad",
+        "age": 44,
         "alive": "true",
-        "friend": [{"name": "Rick", "age": 45}, {"name": "Glenn"}],
+        "friend": [{"name": "Silas", "age": 51}, {"name": "Imre"}],
     }
     p = unmarshal(node, Person)
-    assert p.name == "Michonne" and p.age == 38 and p.alive is True
-    assert [f.name for f in p.friend] == ["Rick", "Glenn"]
-    assert p.friend[0].age == 45
+    assert p.name == "Noor Haddad" and p.age == 44 and p.alive is True
+    assert [f.name for f in p.friend] == ["Silas", "Imre"]
+    assert p.friend[0].age == 51
 
 
 def test_unmarshal_field_override():

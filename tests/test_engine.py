@@ -3,7 +3,8 @@
 Mirrors the reference's dominant test pattern (SURVEY.md §4): an
 in-process store populated via the real mutation path, GraphQL± strings
 through parse → execute → JSON, compared against golden dicts.  The
-fixture graph is modeled on query/query_test.go's populateGraph.
+fixture graph is an original cast (same COVERAGE as the reference's
+populateGraph — friends, ages, facets, geo, langs — different data).
 """
 
 import numpy as np
@@ -32,27 +33,27 @@ def engine():
     mutation {
       schema { %s }
       set {
-        <0x1> <name> "Michonne" .
-        <0x1> <age> "38"^^<xs:int> .
-        <0x1> <alive> "true"^^<xs:boolean> .
-        <0x1> <dob> "1910-01-01" .
-        <0x1> <loc> "{\\"type\\":\\"Point\\",\\"coordinates\\":[-122.4,37.77]}"^^<geo> .
-        <0x17> <name> "Rick Grimes" .
-        <0x17> <age> "15"^^<xs:int> .
-        <0x18> <name> "Glenn Rhee" .
-        <0x18> <age> "15"^^<xs:int> .
-        <0x19> <name> "Daryl Dixon" .
-        <0x19> <age> "17"^^<xs:int> .
-        <0x1f> <name> "Andrea" .
-        <0x1f> <age> "19"^^<xs:int> .
-        <0x1> <friend> <0x17> (since=2006-01-02) .
-        <0x1> <friend> <0x18> (since=2004-05-02, close=true) .
-        <0x1> <friend> <0x19> .
-        <0x1> <friend> <0x1f> .
-        <0x1> <friend> <0x65> .
-        <0x17> <friend> <0x1> .
-        <0x19> <friend> <0x18> .
-        <0x1f> <friend> <0x18> .
+        <0x2> <name> "Noor Haddad" .
+        <0x2> <age> "44"^^<xs:int> .
+        <0x2> <alive> "true"^^<xs:boolean> .
+        <0x2> <dob> "1923-03-14" .
+        <0x2> <loc> "{\\"type\\":\\"Point\\",\\"coordinates\\":[2.35,48.86]}"^^<geo> .
+        <0x21> <name> "Silas Reed" .
+        <0x21> <age> "24"^^<xs:int> .
+        <0x22> <name> "Imre Toth" .
+        <0x22> <age> "24"^^<xs:int> .
+        <0x23> <name> "Devi Kapoor" .
+        <0x23> <age> "29"^^<xs:int> .
+        <0x2b> <name> "Asha Vale" .
+        <0x2b> <age> "33"^^<xs:int> .
+        <0x2> <friend> <0x21> (since=2011-04-03) .
+        <0x2> <friend> <0x22> (since=2009-08-15, close=true) .
+        <0x2> <friend> <0x23> .
+        <0x2> <friend> <0x2b> .
+        <0x2> <friend> <0x71> .
+        <0x21> <friend> <0x2> .
+        <0x23> <friend> <0x22> .
+        <0x2b> <friend> <0x22> .
       }
     }""" % SCHEMA)
     return eng
@@ -60,16 +61,16 @@ def engine():
 
 def test_basic_one_hop(engine):
     got = engine.run("""
-    { me(func: uid(0x1)) { name friend { name } } }""")
+    { me(func: uid(0x2)) { name friend { name } } }""")
     assert got == {
         "me": [
             {
-                "name": "Michonne",
+                "name": "Noor Haddad",
                 "friend": [
-                    {"name": "Rick Grimes"},
-                    {"name": "Glenn Rhee"},
-                    {"name": "Daryl Dixon"},
-                    {"name": "Andrea"},
+                    {"name": "Silas Reed"},
+                    {"name": "Imre Toth"},
+                    {"name": "Devi Kapoor"},
+                    {"name": "Asha Vale"},
                 ],
             }
         ]
@@ -79,51 +80,51 @@ def test_basic_one_hop(engine):
 def test_eq_and_term_filter(engine):
     got = engine.run("""
     {
-      me(func: eq(name, "Michonne")) {
-        friend @filter(anyofterms(name, "rick andrea")) { name }
+      me(func: eq(name, "Noor Haddad")) {
+        friend @filter(anyofterms(name, "silas asha")) { name }
       }
     }""")
     assert got == {
-        "me": [{"friend": [{"name": "Rick Grimes"}, {"name": "Andrea"}]}]
+        "me": [{"friend": [{"name": "Silas Reed"}, {"name": "Asha Vale"}]}]
     }
 
 
 def test_ineq_order_pagination(engine):
     got = engine.run("""
-    { me(func: ge(age, 15), orderasc: age, first: 3) { name age } }""")
+    { me(func: ge(age, 24), orderasc: age, first: 3) { name age } }""")
     assert got == {
         "me": [
-            {"name": "Rick Grimes", "age": 15},
-            {"name": "Glenn Rhee", "age": 15},
-            {"name": "Daryl Dixon", "age": 17},
+            {"name": "Silas Reed", "age": 24},
+            {"name": "Imre Toth", "age": 24},
+            {"name": "Devi Kapoor", "age": 29},
         ]
     }
     got = engine.run("""
-    { me(func: gt(age, 17), orderdesc: age) { name } }""")
-    assert got == {"me": [{"name": "Michonne"}, {"name": "Andrea"}]}
+    { me(func: gt(age, 29), orderdesc: age) { name } }""")
+    assert got == {"me": [{"name": "Noor Haddad"}, {"name": "Asha Vale"}]}
 
 
 def test_counts(engine):
-    got = engine.run("{ me(func: uid(0x1)) { count(friend) } }")
+    got = engine.run("{ me(func: uid(0x2)) { count(friend) } }")
     assert got == {"me": [{"count(friend)": 5}]}
     got = engine.run("{ me(func: ge(count(friend), 1)) { count() } }")
     assert got == {"me": [{"count": 4}]}
     # reverse count
-    got = engine.run("{ me(func: uid(0x18)) { count(~friend) } }")
+    got = engine.run("{ me(func: uid(0x22)) { count(~friend) } }")
     assert got == {"me": [{"count(~friend)": 3}]}
 
 
 def test_filter_and_or_not(engine):
     got = engine.run("""
     {
-      me(func: uid(0x1)) {
-        friend @filter(anyofterms(name, "rick glenn daryl andrea")
-                       and not eq(name, "Rick Grimes")) { name }
+      me(func: uid(0x2)) {
+        friend @filter(anyofterms(name, "silas imre devi asha")
+                       and not eq(name, "Silas Reed")) { name }
       }
     }""")
     assert got == {
         "me": [{"friend": [
-            {"name": "Glenn Rhee"}, {"name": "Daryl Dixon"}, {"name": "Andrea"},
+            {"name": "Imre Toth"}, {"name": "Devi Kapoor"}, {"name": "Asha Vale"},
         ]}]
     }
 
@@ -131,15 +132,15 @@ def test_filter_and_or_not(engine):
 def test_uid_vars(engine):
     got = engine.run("""
     {
-      var(func: uid(0x1)) { f as friend }
+      var(func: uid(0x2)) { f as friend }
       me(func: uid(f), orderasc: name) { name }
     }""")
     assert got == {
         "me": [
-            {"name": "Andrea"},
-            {"name": "Daryl Dixon"},
-            {"name": "Glenn Rhee"},
-            {"name": "Rick Grimes"},
+            {"name": "Asha Vale"},
+            {"name": "Devi Kapoor"},
+            {"name": "Imre Toth"},
+            {"name": "Silas Reed"},
         ]
     }
 
@@ -147,15 +148,15 @@ def test_uid_vars(engine):
 def test_value_vars_and_order(engine):
     got = engine.run("""
     {
-      var(func: uid(0x1)) { friend { a as age } }
-      me(func: uid(0x17, 0x18, 0x19, 0x1f), orderdesc: val(a)) { name age }
+      var(func: uid(0x2)) { friend { a as age } }
+      me(func: uid(0x21, 0x22, 0x23, 0x2b), orderdesc: val(a)) { name age }
     }""")
     assert got == {
         "me": [
-            {"name": "Andrea", "age": 19},
-            {"name": "Daryl Dixon", "age": 17},
-            {"name": "Rick Grimes", "age": 15},
-            {"name": "Glenn Rhee", "age": 15},
+            {"name": "Asha Vale", "age": 33},
+            {"name": "Devi Kapoor", "age": 29},
+            {"name": "Silas Reed", "age": 24},
+            {"name": "Imre Toth", "age": 24},
         ]
     }
 
@@ -163,48 +164,48 @@ def test_value_vars_and_order(engine):
 def test_has_and_reverse(engine):
     got = engine.run("{ me(func: has(friend), orderasc: name) { name } }")
     assert [x.get("name") for x in got["me"]] == [
-        "Andrea", "Daryl Dixon", "Michonne", "Rick Grimes",
+        "Asha Vale", "Devi Kapoor", "Noor Haddad", "Silas Reed",
     ]
-    got = engine.run("{ me(func: uid(0x18)) { ~friend { name } } }")
+    got = engine.run("{ me(func: uid(0x22)) { ~friend { name } } }")
     assert got == {
         "me": [{"~friend": [
-            {"name": "Michonne"}, {"name": "Daryl Dixon"}, {"name": "Andrea"},
+            {"name": "Noor Haddad"}, {"name": "Devi Kapoor"}, {"name": "Asha Vale"},
         ]}]
     }
 
 
 def test_regexp(engine):
-    got = engine.run('{ me(func: regexp(name, /^Ri.*es$/)) { name } }')
-    assert got == {"me": [{"name": "Rick Grimes"}]}
+    got = engine.run('{ me(func: regexp(name, /^Si.*ed$/)) { name } }')
+    assert got == {"me": [{"name": "Silas Reed"}]}
 
 
 def test_geo_near(engine):
     got = engine.run(
-        '{ me(func: near(loc, [-122.4, 37.77], 1000)) { name } }'
+        '{ me(func: near(loc, [2.35, 48.86], 1000)) { name } }'
     )
-    assert got == {"me": [{"name": "Michonne"}]}
+    assert got == {"me": [{"name": "Noor Haddad"}]}
 
 
 def test_math_and_val(engine):
     got = engine.run("""
     {
-      var(func: uid(0x1)) { friend { a as age b as math(a * 2 + 1) } }
-      me(func: uid(0x17), orderasc: name) { name val(b) }
+      var(func: uid(0x2)) { friend { a as age b as math(a * 2 + 1) } }
+      me(func: uid(0x21), orderasc: name) { name val(b) }
     }""")
-    assert got == {"me": [{"name": "Rick Grimes", "val(b)": 31.0}]}
+    assert got == {"me": [{"name": "Silas Reed", "val(b)": 49.0}]}
 
 
 def test_aggregation(engine):
     got = engine.run("""
     {
-      me(func: uid(0x1)) {
+      me(func: uid(0x2)) {
         friend { a as age }
         minAge: min(val(a))
         maxAge: max(val(a))
       }
     }""")
     me = got["me"][0]
-    assert me["minAge"] == 15.0 and me["maxAge"] == 19.0
+    assert me["minAge"] == 24.0 and me["maxAge"] == 33.0
 
 
 def test_count_var_and_filter(engine):
@@ -212,23 +213,23 @@ def test_count_var_and_filter(engine):
     {
       me(func: has(friend)) @filter(gt(count(friend), 1)) { name }
     }""")
-    assert got == {"me": [{"name": "Michonne"}]}
+    assert got == {"me": [{"name": "Noor Haddad"}]}
 
 
 def test_normalize(engine):
     got = engine.run("""
     {
-      me(func: uid(0x1)) @normalize {
+      me(func: uid(0x2)) @normalize {
         Me: name
         friend { Friend: name }
       }
     }""")
     assert got == {
         "me": [
-            {"Me": "Michonne", "Friend": "Rick Grimes"},
-            {"Me": "Michonne", "Friend": "Glenn Rhee"},
-            {"Me": "Michonne", "Friend": "Daryl Dixon"},
-            {"Me": "Michonne", "Friend": "Andrea"},
+            {"Me": "Noor Haddad", "Friend": "Silas Reed"},
+            {"Me": "Noor Haddad", "Friend": "Imre Toth"},
+            {"Me": "Noor Haddad", "Friend": "Devi Kapoor"},
+            {"Me": "Noor Haddad", "Friend": "Asha Vale"},
         ]
     }
 
@@ -236,63 +237,63 @@ def test_normalize(engine):
 def test_cascade(engine):
     got = engine.run("""
     {
-      me(func: uid(0x1)) @cascade {
+      me(func: uid(0x2)) @cascade {
         name
         friend @cascade { name age }
       }
     }""")
-    # 0x17 Rick(15), 0x18 Glenn(15), 0x19 Daryl(17), 0x1f Andrea(19) all have
-    # name+age; 0x65 has neither → dropped by cascade
+    # 0x21 Silas(24), 0x22 Imre(24), 0x23 Devi(29), 0x2b Asha(33) all have
+    # name+age; 0x71 has neither → dropped by cascade
     names = [f["name"] for f in got["me"][0]["friend"]]
-    assert "Rick Grimes" in names and len(names) == 4
+    assert "Silas Reed" in names and len(names) == 4
 
 
 def test_ignorereflex(engine):
     got = engine.run("""
     {
-      me(func: uid(0x17)) @ignorereflex {
+      me(func: uid(0x21)) @ignorereflex {
         name
         friend { name friend @ignorereflex { name } }
       }
     }""")
-    # Rick's friend is Michonne; Michonne's friends minus Rick himself…
+    # Silas's friend is Noor Haddad; Noor Haddad's friends minus Silas himself…
     inner = got["me"][0]["friend"][0]["friend"]
-    assert {"name": "Rick Grimes"} not in inner
+    assert {"name": "Silas Reed"} not in inner
 
 
 def test_facets_output(engine):
     got = engine.run("""
     {
-      me(func: uid(0x1)) {
-        friend @facets(since) @filter(eq(name, "Glenn Rhee")) { name }
+      me(func: uid(0x2)) {
+        friend @facets(since) @filter(eq(name, "Imre Toth")) { name }
       }
     }""")
     f = got["me"][0]["friend"][0]
-    assert f["name"] == "Glenn Rhee"
-    assert f["@facets"]["_"]["since"].startswith("2004-05-02")
+    assert f["name"] == "Imre Toth"
+    assert f["@facets"]["_"]["since"].startswith("2009-08-15")
 
 
 def test_facet_filter(engine):
     got = engine.run("""
     {
-      me(func: uid(0x1)) {
+      me(func: uid(0x2)) {
         friend @facets(eq(close, true)) { name }
       }
     }""")
-    assert got == {"me": [{"friend": [{"name": "Glenn Rhee"}]}]}
+    assert got == {"me": [{"friend": [{"name": "Imre Toth"}]}]}
 
 
 def test_recurse(engine):
     got = engine.run("""
     {
-      recurse(func: uid(0x1), depth: 2) { name friend }
+      recurse(func: uid(0x2), depth: 2) { name friend }
     }""")
     me = got["recurse"][0]
-    assert me["name"] == "Michonne"
+    assert me["name"] == "Noor Haddad"
     lvl1 = me["friend"]
     names = {x.get("name") for x in lvl1}
-    assert "Rick Grimes" in names
-    # level 2 under Daryl/Andrea reaches Glenn — but Glenn already visited at
+    assert "Silas Reed" in names
+    # level 2 under Devi/Asha Vale reaches Imre — but Imre already visited at
     # level 1, so dedup keeps him only once overall
     def count_name(obj, name):
         n = 0
@@ -305,39 +306,39 @@ def test_recurse(engine):
             for v in obj:
                 n += count_name(v, name)
         return n
-    assert count_name(got, "Glenn Rhee") == 1
+    assert count_name(got, "Imre Toth") == 1
 
 
 def test_shortest_path(engine):
     got = engine.run("""
     {
-      shortest(from: 0x17, to: 0x18) { friend }
+      shortest(from: 0x21, to: 0x22) { friend }
     }""")
     path = got["_path_"][0]
-    # Rick -> Michonne -> Glenn, hops keyed by the traversed predicate
-    assert path["_uid_"] == "0x17"
-    assert path["friend"][0]["_uid_"] == "0x1"
-    assert path["friend"][0]["friend"][0]["_uid_"] == "0x18"
+    # Silas -> Noor Haddad -> Imre, hops keyed by the traversed predicate
+    assert path["_uid_"] == "0x21"
+    assert path["friend"][0]["_uid_"] == "0x2"
+    assert path["friend"][0]["friend"][0]["_uid_"] == "0x22"
 
 
 def test_expand_all(engine):
     got = engine.run("""
-    { me(func: uid(0x18)) { expand(_all_) } }""")
+    { me(func: uid(0x22)) { expand(_all_) } }""")
     me = got["me"][0]
-    assert me["name"] == "Glenn Rhee" and me["age"] == 15
+    assert me["name"] == "Imre Toth" and me["age"] == 24
 
 
 def test_groupby(engine):
     got = engine.run("""
     {
-      me(func: uid(0x1)) {
+      me(func: uid(0x2)) {
         friend @groupby(age) { count(_uid_) }
       }
     }""")
     groups = got["me"][0]["friend"][0]["@groupby"]
-    assert {"age": 15, "count": 2} in groups
-    assert {"age": 17, "count": 1} in groups
-    assert {"age": 19, "count": 1} in groups
+    assert {"age": 24, "count": 2} in groups
+    assert {"age": 29, "count": 1} in groups
+    assert {"age": 33, "count": 1} in groups
 
 
 def test_mutation_then_query_and_delete(engine):
@@ -364,15 +365,15 @@ def test_mutation_then_query_and_delete(engine):
 
 def test_alias_output(engine):
     got = engine.run("""
-    { me(func: uid(0x1)) { fullname: name pals: friend { name } } }""")
+    { me(func: uid(0x2)) { fullname: name pals: friend { name } } }""")
     me = got["me"][0]
-    assert me["fullname"] == "Michonne"
+    assert me["fullname"] == "Noor Haddad"
     assert len(me["pals"]) == 4
 
 
 def test_uid_output(engine):
-    got = engine.run("{ me(func: uid(0x1)) { _uid_ name } }")
-    assert got == {"me": [{"_uid_": "0x1", "name": "Michonne"}]}
+    got = engine.run("{ me(func: uid(0x2)) { _uid_ name } }")
+    assert got == {"me": [{"_uid_": "0x2", "name": "Noor Haddad"}]}
 
 
 def test_lang_values(engine):
@@ -381,29 +382,29 @@ def test_lang_values(engine):
     mutation {
       schema { name: string @index(exact) . }
       set {
-        <0x1> <name> "Tree" .
-        <0x1> <name> "Baum"@de .
+        <0x2> <name> "Tree" .
+        <0x2> <name> "Baum"@de .
       }
     }""")
-    got = eng.run("{ q(func: uid(0x1)) { name@de } }")
+    got = eng.run("{ q(func: uid(0x2)) { name@de } }")
     assert got == {"q": [{"name@de": "Baum"}]}
-    got = eng.run("{ q(func: uid(0x1)) { name } }")
+    got = eng.run("{ q(func: uid(0x2)) { name } }")
     assert got == {"q": [{"name": "Tree"}]}
 
 
 def test_regexp_star_quantifier_not_pruned(engine):
-    # /Grimes*/ must match "Rick Grimes" (the 's' is optional, so 'mes'
+    # /Ree[dz]*/ must match "Silas Reed" (the 'd' is optional, so 'eed'
     # trigrams from the run are NOT all required); regression for unsound
     # trigram pruning of * and {m,n} quantifiers
-    got = engine.run('{ me(func: regexp(name, /Grime[sz]*/)) { name } }')
-    assert got == {"me": [{"name": "Rick Grimes"}]}
-    got = engine.run('{ me(func: regexp(name, /Michonnes*/)) { name } }')
-    assert got == {"me": [{"name": "Michonne"}]}
-    got = engine.run('{ me(func: regexp(name, /Michonnes{0,2}/)) { name } }')
-    assert got == {"me": [{"name": "Michonne"}]}
+    got = engine.run('{ me(func: regexp(name, /Ree[dz]*/)) { name } }')
+    assert got == {"me": [{"name": "Silas Reed"}]}
+    got = engine.run('{ me(func: regexp(name, /Noor Haddads*/)) { name } }')
+    assert got == {"me": [{"name": "Noor Haddad"}]}
+    got = engine.run('{ me(func: regexp(name, /Noor Haddads{0,2}/)) { name } }')
+    assert got == {"me": [{"name": "Noor Haddad"}]}
 
 
 def test_regexp_group_quantifier_not_pruned(engine):
     # (son)* — group contents are optional, must not be required trigrams
-    got = engine.run('{ me(func: regexp(name, /Rick(son)* Grimes/)) { name } }')
-    assert got == {"me": [{"name": "Rick Grimes"}]}
+    got = engine.run('{ me(func: regexp(name, /Silas(son)* Reed/)) { name } }')
+    assert got == {"me": [{"name": "Silas Reed"}]}

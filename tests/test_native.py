@@ -9,10 +9,10 @@ from dgraph_tpu.models import PostingStore
 from dgraph_tpu.serve.mutations import apply_mutation
 
 CORPUS = r"""
-<0x1> <name> "Michonne" .
-<0x1> <age> "38"^^<xs:int> .
-<0x2> <name> "Rick \"the\" Grimes" .
-<0x1> <friend> <0x2> (since=2004-05-02, close=true, weight=1.5) .
+<0x1> <name> "Noor Haddad" .
+<0x1> <age> "44"^^<xs:int> .
+<0x2> <name> "Silas \"the\" Reed" .
+<0x1> <friend> <0x2> (since=2009-08-15, close=true, weight=1.5) .
 <0x2> <friend> <0x3> .
 _:blank1 <name> "Blanka" .
 _:blank1 <knows> _:blank2 .
