@@ -40,6 +40,9 @@ def build_options(argv=None) -> Options:
     p.add_argument("--idx", dest="raft_id", type=int, default=d.raft_id)
     p.add_argument("--groups", dest="group_ids", default=d.group_ids)
     p.add_argument("--peer", default=d.peer)
+    p.add_argument("--peer_groups", default=d.peer_groups,
+                   help='per-peer group placement "1=0,1;2=0,2"; absent '
+                        "peers serve every group")
     p.add_argument("--join", default=d.join,
                    help="address of a live cluster member; boot as a "
                         "joining node and acquire membership at runtime")
@@ -96,7 +99,11 @@ def main(argv=None) -> int:
     elif opts.peer:
         # clustered boot (StartRaftNodes analog): durability lives in the
         # raft logs + snapshots under the postings dir
-        from dgraph_tpu.cluster.service import ClusterService, parse_peers
+        from dgraph_tpu.cluster.service import (
+            ClusterService,
+            parse_peer_groups,
+            parse_peers,
+        )
 
         scheme = "https" if opts.tls_cert else "http"
         my_addr = opts.my_addr or f"{scheme}://127.0.0.1:{opts.port}"
@@ -110,6 +117,7 @@ def main(argv=None) -> int:
             secret=opts.cluster_secret,
             peer_ca=opts.peer_ca,
             peer_tls_insecure=opts.peer_tls_insecure,
+            peer_groups=parse_peer_groups(opts.peer_groups),
         )
         has_https_peer = any(
             a.startswith("https://") for a in cluster.peers.values()

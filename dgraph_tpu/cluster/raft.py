@@ -350,6 +350,13 @@ class RaftNode:
         receiving appends/snapshots immediately.  Idempotent."""
         self._inbox.put(("conf_add", nid))
 
+    def remove_peer(self, nid: str) -> None:
+        """Runtime membership removal: a MEMBER record declaring a peer's
+        group placement excludes it from groups it does not serve — a
+        voter that never answers would otherwise depress this group's
+        quorum forever.  Idempotent; removing an absent peer is a no-op."""
+        self._inbox.put(("conf_remove", nid))
+
     def propose_and_wait(self, data: bytes, timeout: float = 10.0):
         """draft.go:341 ProposeAndWait: block until applied or error."""
         return self.propose(data).result(timeout=timeout)
@@ -380,6 +387,8 @@ class RaftNode:
                     self._handle_propose(item[1], item[2])
                 elif kind == "conf_add":
                     self._handle_conf_add(item[1])
+                elif kind == "conf_remove":
+                    self._handle_conf_remove(item[1])
             except Exception:  # noqa: BLE001 — a bad entry/storage error must
                 # not silently kill the event loop and wedge the group
                 import traceback
@@ -415,6 +424,17 @@ class RaftNode:
                 self._send_append(nid)
         # learning a real peer activates a passive joiner
         self.passive = False
+
+    def _handle_conf_remove(self, nid: str) -> None:
+        if nid == self.node_id or nid not in self.peers:
+            return
+        self.peers.remove(nid)
+        self.next_index.pop(nid, None)
+        self.match_index.pop(nid, None)
+        if self.state == LEADER:
+            # quorum may have shrunk: entries waiting on the removed voter
+            # can be committable now
+            self._maybe_commit()
 
     # -- elections ----------------------------------------------------------
 

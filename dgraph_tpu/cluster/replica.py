@@ -54,6 +54,49 @@ def state_to_bytes(store: PostingStore) -> bytes:
     return bytes(buf)
 
 
+def pred_to_bytes(store: PostingStore, pred: str) -> bytes:
+    """One predicate's postings as a CRC-framed record stream — the
+    payload of the cross-server read path (/pred-snapshot).  The analog of
+    the reference's PredicateAndSchemaData shard stream
+    (worker/predicate.go:71-201), scoped to one predicate."""
+    pd = store.peek(pred)
+    buf = bytearray()
+    if pd is None:
+        return bytes(buf)
+    for src in sorted(pd.edges):
+        for dst in sorted(pd.edges[src]):
+            payload = codec.encode_edge(
+                Edge(pred=pred, src=src, dst=dst,
+                     facets=pd.edge_facets.get((src, dst)))
+            )
+            buf.extend(_HDR.pack(len(payload), zlib.crc32(payload)))
+            buf.extend(payload)
+    for (src, lang) in sorted(pd.values):
+        payload = codec.encode_edge(
+            Edge(pred=pred, src=src, value=pd.values[(src, lang)],
+                 lang=lang, facets=pd.value_facets.get(src))
+        )
+        buf.extend(_HDR.pack(len(payload), zlib.crc32(payload)))
+        buf.extend(payload)
+    return bytes(buf)
+
+
+def bytes_to_pred(data: bytes, pred: str):
+    """Decode a pred_to_bytes stream into a standalone PredicateData."""
+    tmp = PostingStore()
+    pos = 0
+    n = len(data)
+    while pos + _HDR.size <= n:
+        length, crc = _HDR.unpack_from(data, pos)
+        start = pos + _HDR.size
+        payload = data[start : start + length]
+        if zlib.crc32(payload) != crc:
+            raise ValueError("corrupt predicate snapshot payload")
+        apply_record(tmp, payload)
+        pos = start + length
+    return tmp.peek(pred)
+
+
 def bytes_to_state(data: bytes, store: PostingStore) -> None:
     """Replace store contents from a snapshot payload."""
     store._preds.clear()
@@ -88,6 +131,12 @@ class ReplicatedGroup:
     ):
         self.store = PostingStore()
         self.group = group
+        # per-predicate change versions = the raft index of the last record
+        # touching the predicate: durable-monotone across restarts and
+        # identical on every replica (unlike a process-local counter, which
+        # could repeat a value over different content after a restart and
+        # make remote readers' 304 checks serve stale data forever)
+        self.pred_versions: Dict[str, int] = {}
         self._lock = threading.Lock()  # guards store during apply/snapshot
         storage = RaftStorage(
             os.path.join(directory, f"raft-g{group}"), sync=sync_writes
@@ -115,7 +164,9 @@ class ReplicatedGroup:
     def _apply_committed(self, index: int, data: bytes) -> None:
         with self._lock:
             for payload in decode_batch(data):
-                apply_record(self.store, payload)
+                pred = apply_record(self.store, payload)
+                if pred is not None:
+                    self.pred_versions[pred] = index
 
     def _snapshot_state(self) -> bytes:
         with self._lock:
@@ -126,6 +177,15 @@ class ReplicatedGroup:
             return
         with self._lock:
             bytes_to_state(data, self.store)
+            # every predicate in the snapshot is current as of its index
+            snap_idx = self.node.storage.snap_index
+            self.pred_versions = {
+                p: snap_idx for p in self.store._preds.keys()
+            }
+
+    def pred_version(self, pred: str) -> int:
+        """Caller holds _lock (or tolerates a racy read)."""
+        return self.pred_versions.get(pred, 0)
 
     # -- public write path ---------------------------------------------------
 

@@ -66,6 +66,21 @@ def parse_peers(peer_spec: str, default_scheme: str = "http") -> Dict[str, str]:
     return out
 
 
+def parse_peer_groups(spec: str) -> Dict[str, List[int]]:
+    """"1=0,1;2=0,2" → {peer-id: [group,...]}.  Empty spec = {} (every
+    peer serves every group)."""
+    out: Dict[str, List[int]] = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        nid, _, gs = part.partition("=")
+        if not gs:
+            raise ValueError(f"peer_groups entry {part!r} must be id=g1,g2")
+        out[nid.strip()] = [int(g) for g in gs.split(",") if g.strip()]
+    return out
+
+
 class ClusterService:
     """Owns this server's raft groups, transport, lease and store facade."""
 
@@ -81,6 +96,7 @@ class ClusterService:
         secret: str = "",
         peer_ca: str = "",
         peer_tls_insecure: bool = False,
+        peer_groups: Optional[Dict[str, List[int]]] = None,
         **raft_opts,
     ):
         if METADATA_GROUP not in group_ids:
@@ -101,10 +117,32 @@ class ClusterService:
             {nid: a for nid, a in self.peers.items() if nid != node_id},
             auth=self.auth,
         )
+        # static placement (group/conf.go's server-side complement): which
+        # groups each peer serves.  None/missing peer = serves everything
+        # (full replication, the pre-placement behavior).  The metadata
+        # group always spans every server.  MEMBER records refine this at
+        # runtime (groups.go syncMemberships analog).
+        self.peer_groups: Dict[str, Tuple[int, ...]] = {
+            nid: tuple(sorted(set(gs) | {METADATA_GROUP}))
+            for nid, gs in (peer_groups or {}).items()
+        }
+        self.peer_groups[node_id] = tuple(sorted(group_ids))
         peer_ids = sorted(self.peers)
+
+        def raft_peers(g: int) -> List[str]:
+            # a group's raft cluster spans only the servers that SERVE it;
+            # peers with unknown placement are assumed to serve everything
+            return [
+                nid
+                for nid in peer_ids
+                if g == METADATA_GROUP
+                or nid not in self.peer_groups
+                or g in self.peer_groups[nid]
+            ]
+
         self.groups: Dict[int, ReplicatedGroup] = {
             g: ReplicatedGroup(
-                node_id=node_id, group=g, peers=peer_ids, directory=directory,
+                node_id=node_id, group=g, peers=raft_peers(g), directory=directory,
                 transport=self.transport, sync_writes=sync_writes, **raft_opts,
             )
             for g in group_ids
@@ -123,6 +161,26 @@ class ClusterService:
         # resume the lease above everything the metadata replica has seen
         meta = self.groups[METADATA_GROUP].store
         self.lease.init_from_recovery(meta.uids.max_uid + 1)
+        # announce our own placement through the metadata group so every
+        # server learns group→server routing (syncMemberships,
+        # worker/groups.go:404 — periodic there, once-with-retry here
+        # since membership is static between joins)
+        threading.Thread(
+            target=self._announce_self, name="announce", daemon=True
+        ).start()
+
+    def _announce_self(self) -> None:
+        import time
+
+        rec = codec.encode_member(
+            self.node_id, self.peers[self.node_id], sorted(self.groups)
+        )
+        for _ in range(50):
+            try:
+                self.propose_records(METADATA_GROUP, [rec])
+                return
+            except Exception:
+                time.sleep(0.2)
 
     def stop(self) -> None:
         for g in self.groups.values():
@@ -156,11 +214,37 @@ class ClusterService:
             self.peers = {**self.peers, nid: addr}
             self.transport.addr_of = {**self.transport.addr_of, nid: addr}
         member_groups = set(groups) if groups else None
+        if member_groups is not None:
+            self.peer_groups = {
+                **self.peer_groups,
+                nid: tuple(sorted(member_groups | {METADATA_GROUP})),
+            }
         for gid, g in self.groups.items():
             # empty group list = legacy record = member serves every group;
             # the metadata group always includes every member
             if member_groups is None or gid in member_groups or gid == METADATA_GROUP:
                 g.node.add_peer(nid)
+            elif member_groups is not None:
+                # the record authoritatively says this member does NOT
+                # serve gid: drop it from the voter set so it can never
+                # depress the group's quorum (no removal path existed)
+                g.node.remove_peer(nid)
+
+    def servers_of_group(self, gid: int) -> List[Tuple[str, str]]:
+        """(node_id, addr) of every server EXPLICITLY placing group
+        ``gid``, self excluded — the remote-read / remote-propose
+        candidate list.  Peers with unknown placement are NOT counted:
+        in legacy full-replication clusters every server already holds
+        every group locally, so routing to an undeclared peer could only
+        hit a server that errors 'group not served here'."""
+        out = []
+        for nid, addr in sorted(self.peers.items()):
+            if nid == self.node_id:
+                continue
+            gs = self.peer_groups.get(nid)
+            if gs is not None and gid in gs:
+                out.append((nid, addr))
+        return out
 
     def handle_join(self, nid: str, addr: str, groups=()) -> Dict[str, str]:
         """Server side of a join request: replicate the new member
@@ -223,12 +307,36 @@ class ClusterService:
         self, group: int, records: List[bytes], timeout: float = 10.0
     ) -> None:
         """Propose, forwarding to the leader over HTTP when we're not it
-        (proposeOrSend: local → ProposeAndWait, remote → RPC)."""
+        (proposeOrSend: local → ProposeAndWait, remote → RPC).  A group
+        this server does not place routes straight to that group's
+        servers (MutateOverNetwork's remote grpc Mutate leg)."""
         batch = encode_batch(records)
+        if group not in self.groups:
+            return self._propose_remote_group(group, batch, timeout)
         self._route_to_leader(
             lambda: self.propose_local(group, batch, timeout),
             lambda peer: self._forward(peer, group, batch, timeout),
         )
+
+    def _propose_remote_group(self, group: int, batch: bytes, timeout: float):
+        members = self.servers_of_group(group)
+        if not members:
+            raise NotLeaderError(None)
+        tried: set = set()
+        target = members[0][0]
+        for _ in range(2 * len(members) + 2):
+            if target is None or target in tried:
+                target = next(
+                    (nid for nid, _a in members if nid not in tried), None
+                )
+                if target is None:
+                    break
+            _res, hint, ok = self._forward(target, group, batch, timeout)
+            if ok:
+                return
+            tried.add(target)
+            target = hint
+        raise NotLeaderError(None)
 
     def _route_to_leader(
         self,
@@ -283,6 +391,58 @@ class ClusterService:
 
     def _propose_lease(self, new_max: int) -> None:
         self.propose_records(METADATA_GROUP, [codec.encode_lease(new_max)])
+
+    # -- cross-server reads (ServeTask analog, worker/task.go:54-68) --------
+
+    def fetch_pred_snapshot(
+        self, pred: str, gid: int, since: int, timeout: float = 10.0
+    ):
+        """Pull a predicate snapshot from a server of its owning group.
+
+        Returns (version, payload-bytes) — payload None when the remote
+        copy is unchanged since ``since``.  Data ships to the query (the
+        inversion of the reference's per-task fan-out): the reader caches
+        the predicate and builds device arenas from it locally, so one
+        transfer serves every subsequent query until the owner mutates.
+        Raises OSError when no owning server is reachable."""
+        last_err: Optional[Exception] = None
+        for _nid, addr in self.servers_of_group(gid):
+            from urllib.parse import quote
+
+            url = (
+                f"{addr}/pred-snapshot?name="
+                + quote(pred, safe="")
+                + f"&since={since}"
+            )
+            req = urllib.request.Request(url)
+            try:
+                with urlopen_peer(req, timeout, self.auth) as resp:
+                    ver = int(resp.headers.get("X-Pred-Version", "0"))
+                    if resp.status == 204:
+                        return ver, None
+                    return ver, resp.read()
+            except urllib.error.HTTPError as e:
+                if e.code == 304:
+                    return since, None
+                last_err = e
+            except OSError as e:
+                last_err = e
+        raise last_err or OSError(f"no server for group {gid}")
+
+    def fetch_predlist(self, gid: int, timeout: float = 5.0) -> Optional[List[str]]:
+        """Predicate names a remote group currently stores; None when no
+        owning server is reachable (distinct from a legitimately empty
+        group, so stale caches converge after deletes)."""
+        import json as _json
+
+        for _nid, addr in self.servers_of_group(gid):
+            req = urllib.request.Request(f"{addr}/predlist?group={gid}")
+            try:
+                with urlopen_peer(req, timeout, self.auth) as resp:
+                    return list(_json.loads(resp.read()))
+            except (urllib.error.HTTPError, OSError):
+                continue
+        return None
 
     # -- uid assignment (leader-only, worker/assign.go:59) ------------------
 
@@ -402,12 +562,22 @@ class ClusterStore:
     Implements PostingStore's read/write surface (duck-typed — the engine
     and serving layer never isinstance-check)."""
 
-    def __init__(self, svc: ClusterService):
+    def __init__(self, svc: ClusterService, remote_ttl: float = 0.1):
         self._svc = svc
         self.uids = _ClusterUids(svc)
         self._dirty: set = set()
         self._snaps: Dict[str, PredicateData] = {}
         self._snap_lock = threading.Lock()
+        # cross-server read cache: pred -> [version, PredicateData|None,
+        # last-freshness-check monotonic time].  Freshness is checked at
+        # most every remote_ttl seconds (bounded staleness, matching the
+        # reference's eventually-consistent AnyServer reads).  Guarded by
+        # its OWN lock: remote fetches block on the network and must never
+        # stall local reads holding _snap_lock.
+        self._remote: Dict[str, list] = {}
+        self._predlists: Dict[int, list] = {}
+        self._remote_lock = threading.Lock()
+        self.remote_ttl = remote_ttl
 
     @property
     def dirty(self) -> set:
@@ -455,11 +625,48 @@ class ClusterStore:
     # -- reads (snapshot copies of local replicas) --------------------------
 
     def _owner_gid(self, pred: str) -> int:
+        """The group that PLACES this predicate.  Local groups and groups
+        some peer serves route truthfully; a group nobody places (legacy
+        single-server configs whose conf names more groups than servers)
+        falls back to the metadata group as before."""
         gid = self._svc.conf.belongs_to(pred)
-        return gid if gid in self._svc.groups else METADATA_GROUP
+        if gid in self._svc.groups or self._svc.servers_of_group(gid):
+            return gid
+        return METADATA_GROUP
 
-    def _owner(self, pred: str) -> ReplicatedGroup:
-        return self._svc.groups[self._owner_gid(pred)]
+    def _remote_peek(self, pred: str, gid: int) -> Optional[PredicateData]:
+        """Read a predicate another group owns: versioned snapshot pull
+        with a TTL-gated freshness probe.  Serves the cached copy when the
+        owner is unreachable (stale reads beat unavailability for the
+        read plane; writes still require the owner's quorum).  Holds only
+        _remote_lock — the network fetch must never stall local reads."""
+        import time as _time
+
+        from dgraph_tpu.cluster.replica import bytes_to_pred
+
+        with self._remote_lock:
+            ent = self._remote.get(pred)
+            now = _time.monotonic()
+            if ent is not None and now - ent[2] < self.remote_ttl:
+                return ent[1]
+            since = ent[0] if ent is not None else -1
+            try:
+                ver, payload = self._svc.fetch_pred_snapshot(pred, gid, since)
+            except OSError:
+                if ent is None:
+                    raise
+                ent[2] = now  # unreachable: serve stale, retry after ttl
+                return ent[1]
+            if ent is not None and payload is None:
+                ent[0], ent[2] = ver, now
+                return ent[1]
+            pd = bytes_to_pred(payload or b"", pred)
+            changed = ent is not None
+            self._remote[pred] = [ver, pd, now]
+        if changed:
+            with self._snap_lock:
+                self._dirty.add(pred)  # arenas rebuild from the fresh copy
+        return pd
 
     def _drain_dirty(self) -> None:
         """Caller holds _snap_lock."""
@@ -477,11 +684,14 @@ class ClusterStore:
                     g.store.dirty.clear()
 
     def peek(self, name: str) -> Optional[PredicateData]:
+        gid = self._owner_gid(name)
+        g = self._svc.groups.get(gid)
+        if g is None:  # another group's data: cross-server read (own lock)
+            return self._remote_peek(name, gid)
         with self._snap_lock:
             self._drain_dirty()
             snap = self._snaps.get(name)
             if snap is None:
-                g = self._owner(name)
                 with g._lock:
                     live = g.store.peek(name)
                     if live is None:
@@ -498,6 +708,23 @@ class ClusterStore:
         for g in self._svc.groups.values():
             with g._lock:
                 out.update(g.store._preds.keys())
+        # union in the predicates of groups this server does not place
+        # (expand(_all_) / export must see the whole graph)
+        import time as _time
+
+        for gid in self._svc.conf.known_groups():
+            if gid in self._svc.groups:
+                continue
+            with self._remote_lock:
+                now = _time.monotonic()
+                ent = self._predlists.get(gid)
+                if ent is None or now - ent[1] >= self.remote_ttl:
+                    names = self._svc.fetch_predlist(gid)
+                    if names is None:  # owner unreachable: keep stale list
+                        names = ent[0] if ent is not None else []
+                    self._predlists[gid] = [names, now]
+                    ent = self._predlists[gid]
+                out.update(ent[0])
         return sorted(out)
 
     def value(self, pred: str, uid: int, lang: str = ""):

@@ -105,13 +105,17 @@ def replay_records(
             f.truncate(good_end)
 
 
-def apply_record(store: PostingStore, payload: bytes) -> None:
+def apply_record(store: PostingStore, payload: bytes):
     """Apply one record to a store WITHOUT journaling — used for WAL/
     snapshot replay, Raft committed-entry application, and replica
-    catch-up (the processMutation → posting apply path, draft.go:514)."""
+    catch-up (the processMutation → posting apply path, draft.go:514).
+    Returns the touched predicate name (or None for non-predicate
+    records) so replicas can version predicates individually."""
     tag = payload[0]
     if tag == codec.EDGE:
-        PostingStore.apply(store, codec.decode_edge(payload))
+        e = codec.decode_edge(payload)
+        PostingStore.apply(store, e)
+        return e.pred
     elif tag == codec.SCHEMA:
         text, _ = codec.get_str(payload, 1)
         parse_schema(text, into=store.schema)
@@ -129,9 +133,11 @@ def apply_record(store: PostingStore, payload: bytes) -> None:
     elif tag == codec.BULKEDGES:
         pred, src, dst = codec.decode_bulk_edges(payload)
         PostingStore.bulk_set_uid_edges(store, pred, src, dst)
+        return pred
     elif tag == codec.DELPRED:
         pred, _ = codec.get_str(payload, 1)
         PostingStore.delete_predicate(store, pred)
+        return pred
     elif tag == codec.MEMBER:
         nid, addr, groups = codec.decode_member(payload)
         store.members[nid] = (addr, tuple(groups))
@@ -140,6 +146,7 @@ def apply_record(store: PostingStore, payload: bytes) -> None:
             hook(nid, addr, groups)
     else:
         raise ValueError(f"unknown WAL record tag {tag:#x}")
+    return None
 
 
 def iter_state_records(store: PostingStore):

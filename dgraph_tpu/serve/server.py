@@ -275,6 +275,47 @@ def _make_handler(srv: DgraphServer):
                     self._err(404, "no such share")
                 else:
                     self._reply(200, json.dumps({"share": q}).encode())
+            elif path == "/pred-snapshot":
+                # cross-server read plane (ServeTask analog): versioned
+                # predicate snapshot for groups other servers don't place
+                if srv.cluster is None:
+                    return self._err(404, "not clustered")
+                if not self._cluster_authorized():
+                    return self._err(403, "cluster secret required")
+                from urllib.parse import parse_qs, unquote
+
+                qs = parse_qs(u.query)
+                name = unquote(qs.get("name", [""])[0])
+                since = int(qs.get("since", ["-1"])[0])
+                gid = srv.cluster.conf.belongs_to(name)
+                g = srv.cluster.groups.get(gid)
+                if g is None:
+                    return self._err(404, f"group {gid} not served here")
+                from dgraph_tpu.cluster.replica import pred_to_bytes
+
+                with g._lock:
+                    ver = g.pred_version(name)
+                    body = b"" if ver == since else pred_to_bytes(g.store, name)
+                self.send_response(204 if ver == since else 200)
+                self.send_header("X-Pred-Version", str(ver))
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if ver != since:
+                    self.wfile.write(body)
+            elif path == "/predlist":
+                if srv.cluster is None:
+                    return self._err(404, "not clustered")
+                if not self._cluster_authorized():
+                    return self._err(403, "cluster secret required")
+                from urllib.parse import parse_qs
+
+                gid = int(parse_qs(u.query).get("group", ["-1"])[0])
+                g = srv.cluster.groups.get(gid)
+                if g is None:
+                    return self._err(404, f"group {gid} not served here")
+                with g._lock:
+                    names = sorted(g.store._preds.keys())
+                self._reply(200, json.dumps(names).encode())
             else:
                 self._err(404, "no such endpoint")
 

@@ -26,6 +26,12 @@ class Options:
     raft_id: int = 1
     group_ids: str = "0"
     peer: str = ""
+    # per-peer group placement: "1=0,1;2=0,2" — which groups each peer
+    # serves; peers absent from the map serve every group (full
+    # replication).  The server-side complement of the predicate→group
+    # rules (group/conf.go), enabling disjoint data placement with
+    # cross-server reads.
+    peer_groups: str = ""
     my_addr: str = ""
     join: str = ""   # address of a live cluster member to join at boot
     workers: int = 4
