@@ -16,13 +16,21 @@ import os
 import resource
 import time
 
+# Measurement config for the axon tunnel (~65ms RTT, ~44MB/s): the
+# per-level device path transfers full padded matrices, which this
+# transport loses to host numpy at every size — route per-level work to
+# the host and let the FUSED chains (one dispatch, frontier-only
+# transfers in light mode) carry the device story.  Co-located
+# deployments keep the 262144 default.
+os.environ.setdefault("DGRAPH_TPU_EXPAND_DEVICE_MIN", str(1 << 62))
+
 from bench_engine import SCHEMA, build
 from dgraph_tpu.models import PostingStore
 from dgraph_tpu.query import QueryEngine
 
-# quads per director in bench_engine.build (1 dir name + 8 films ×
-# (name + date + director.film + genre + 6 × (perf.actor + starring)))
-QUADS_PER_DIRECTOR = 1 + 8 * (4 + 6 * 2)
+# expected quads per director with the zipf generator (measured mean:
+# ~88 — bounded-pareto film/perf counts undershoot the uniform 97)
+QUADS_PER_DIRECTOR = 88
 
 
 def rss_gb() -> float:
@@ -72,14 +80,36 @@ def main():
         "rss_gb": round(rss_gb(), 2),
     }), flush=True)
 
-    # the two wiki shapes, seeded mid-graph
+    # the two wiki shapes.  The 3-hop seeds a MID-TAIL actor — the wiki's
+    # anchor is a typical entity; with the zipf corpus a head actor is a
+    # different (much heavier) workload, measured separately below.
     co_actor = """
-    { me(func: eq(name, "Actor 7")) {
+    { me(func: eq(name, "Actor 250000")) {
         ~performance.actor { ~starring {
           name
           starring { performance.actor { name } }
         } }
     } }"""
+    # head-of-zipf seed: celebrity fan-out, where the fused device chain
+    # engages (its own metric, no wiki anchor to compare against)
+    hot_actor = """
+    { var(func: eq(name, "Actor 7")) {
+        ~performance.actor { ~starring { starring { performance.actor } } }
+    } }"""
+    eng.run(hot_actor)  # warm
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        eng.run(hot_actor)
+        times.append(time.time() - t0)
+    print(json.dumps({
+        "metric": "engine21m_3hop_hot_actor",
+        "value": round(min(times) * 1e3, 2),
+        "unit": "ms",
+        "edges": eng.stats["edges"],
+        "fused_levels": eng.stats["chain_fused_levels"],
+        "edges_per_sec": round(eng.stats["edges"] / min(times), 1),
+    }), flush=True)
     detail = """
     { dir(func: eq(name, "Director 11")) {
         name
@@ -163,6 +193,13 @@ def build_chunk(start_director: int, n_directors: int) -> str:
     def u(x):
         return f"<0x{x:x}>"
 
+    def zipfish(mean: float, hi: int) -> int:
+        """Bounded Pareto(α=2) integer with the given mean: realistic
+        heavy-tailed degrees (a few prolific directors/ensemble films)
+        instead of the uniform tiling VERDICT r2 flagged as flattering
+        caps and cache behavior."""
+        return max(1, min(hi, int(rng.paretovariate(2.0) * mean / 2)))
+
     if start_director == 0:
         for gi in range(GENRES):
             lines.append(f'{u(1 + gi)} <name> "Genre {gi}" .')
@@ -174,7 +211,7 @@ def build_chunk(start_director: int, n_directors: int) -> str:
         d = cursor
         cursor += 1
         lines.append(f'{u(d)} <name> "Director {di}" .')
-        for fi in range(8):
+        for fi in range(zipfish(8, 15)):
             f = cursor
             cursor += 1
             lines.append(f'{u(f)} <name> "Film {di}-{fi}" .')
@@ -183,11 +220,13 @@ def build_chunk(start_director: int, n_directors: int) -> str:
                 f'{u(f)} <initial_release_date> "{y}-0{1 + rng.randrange(9)}-1{rng.randrange(9)}" .'
             )
             lines.append(f"{u(d)} <director.film> {u(f)} .")
-            lines.append(f"{u(f)} <genre> {u(1 + rng.randrange(GENRES))} .")
-            for _ in range(6):
+            # popular genres dominate (zipf over the genre table)
+            lines.append(f"{u(f)} <genre> {u(1 + zipfish(4, GENRES) - 1)} .")
+            for _ in range(zipfish(6, 8)):
                 p = cursor
                 cursor += 1
-                a = 1 + GENRES + rng.randrange(ACTORS)
+                # celebrity skew: a small head of actors takes most roles
+                a = 1 + GENRES + int(ACTORS * (rng.random() ** 4.0))
                 lines.append(f"{u(p)} <performance.actor> {u(a)} .")
                 lines.append(f"{u(f)} <starring> {u(p)} .")
     return "\n".join(lines)

@@ -148,6 +148,7 @@ class ClusterService:
             for g in group_ids
         }
         self.lease = LeaseManager(self._propose_lease)
+        self._stopped = False
         self.store = ClusterStore(self)
         # runtime membership: MEMBER records applied on the metadata
         # replica rewire this server live (groups.go applyMembershipUpdate)
@@ -170,19 +171,34 @@ class ClusterService:
         ).start()
 
     def _announce_self(self) -> None:
+        import sys
         import time
 
         rec = codec.encode_member(
             self.node_id, self.peers[self.node_id], sorted(self.groups)
         )
-        for _ in range(50):
+        attempt = 0
+        delay = 0.2
+        while not self._stopped:
             try:
                 self.propose_records(METADATA_GROUP, [rec])
                 return
-            except Exception:
-                time.sleep(0.2)
+            except Exception as e:  # noqa: BLE001 — keep trying: peers
+                # route reads/writes by this announcement; giving up
+                # silently would leave our groups unreachable forever
+                attempt += 1
+                if attempt == 25:
+                    print(
+                        f"# server {self.node_id}: membership announcement "
+                        f"still failing after {attempt} attempts "
+                        f"({type(e).__name__}: {e}); retrying",
+                        file=sys.stderr,
+                    )
+                time.sleep(delay)
+                delay = min(delay * 1.5, 2.0)
 
     def stop(self) -> None:
+        self._stopped = True
         for g in self.groups.values():
             g.stop()
         self.transport.stop()
@@ -224,10 +240,10 @@ class ClusterService:
             # the metadata group always includes every member
             if member_groups is None or gid in member_groups or gid == METADATA_GROUP:
                 g.node.add_peer(nid)
-            elif member_groups is not None:
+            else:
                 # the record authoritatively says this member does NOT
                 # serve gid: drop it from the voter set so it can never
-                # depress the group's quorum (no removal path existed)
+                # depress the group's quorum
                 g.node.remove_peer(nid)
 
     def servers_of_group(self, gid: int) -> List[Tuple[str, str]]:
@@ -576,7 +592,10 @@ class ClusterStore:
         # stall local reads holding _snap_lock.
         self._remote: Dict[str, list] = {}
         self._predlists: Dict[int, list] = {}
-        self._remote_lock = threading.Lock()
+        self._remote_lock = threading.Lock()  # guards the cache DICTS only
+        # per-predicate fetch locks: one unreachable owner must stall only
+        # its own predicate, not the whole cross-server read plane
+        self._fetch_locks: Dict[str, threading.Lock] = {}
         self.remote_ttl = remote_ttl
 
     @property
@@ -626,12 +645,20 @@ class ClusterStore:
 
     def _owner_gid(self, pred: str) -> int:
         """The group that PLACES this predicate.  Local groups and groups
-        some peer serves route truthfully; a group nobody places (legacy
-        single-server configs whose conf names more groups than servers)
-        falls back to the metadata group as before."""
+        some peer serves route truthfully.  A group nobody is KNOWN to
+        place: in a placement-aware cluster that's a transient state
+        (owners announce via MEMBER records) and must fail loudly — a
+        metadata-group fallback would durably commit writes where future
+        reads will never look.  Only legacy full-replication clusters
+        (no placement info beyond ourselves) keep the old fallback."""
         gid = self._svc.conf.belongs_to(pred)
         if gid in self._svc.groups or self._svc.servers_of_group(gid):
             return gid
+        if len(self._svc.peer_groups) > 1:
+            raise OSError(
+                f"group {gid} has no known server yet (owner not announced); "
+                "retry shortly"
+            )
         return METADATA_GROUP
 
     def _remote_peek(self, pred: str, gid: int) -> Optional[PredicateData]:
@@ -649,20 +676,29 @@ class ClusterStore:
             now = _time.monotonic()
             if ent is not None and now - ent[2] < self.remote_ttl:
                 return ent[1]
+            flock = self._fetch_locks.setdefault(pred, threading.Lock())
+        with flock:  # only THIS predicate's readers wait on the network
+            with self._remote_lock:
+                ent = self._remote.get(pred)
+                now = _time.monotonic()
+                if ent is not None and now - ent[2] < self.remote_ttl:
+                    return ent[1]  # refreshed while we waited for the lock
             since = ent[0] if ent is not None else -1
             try:
                 ver, payload = self._svc.fetch_pred_snapshot(pred, gid, since)
             except OSError:
                 if ent is None:
                     raise
-                ent[2] = now  # unreachable: serve stale, retry after ttl
+                with self._remote_lock:
+                    ent[2] = _time.monotonic()  # unreachable: serve stale
                 return ent[1]
-            if ent is not None and payload is None:
-                ent[0], ent[2] = ver, now
-                return ent[1]
-            pd = bytes_to_pred(payload or b"", pred)
-            changed = ent is not None
-            self._remote[pred] = [ver, pd, now]
+            changed = ent is not None and payload is not None
+            if payload is None:
+                pd = ent[1]
+            else:
+                pd = bytes_to_pred(payload, pred)
+            with self._remote_lock:
+                self._remote[pred] = [ver, pd, _time.monotonic()]
         if changed:
             with self._snap_lock:
                 self._dirty.add(pred)  # arenas rebuild from the fresh copy

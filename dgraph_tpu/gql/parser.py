@@ -216,9 +216,12 @@ class _Parser:
 
     def _parse_query_body(self, res: ParsedResult):
         self.expect("punct", "{")
+        n0 = len(res.queries)
         while not self.accept("punct", "}"):
             self.accept("punct", ",")
             res.queries.append(self._parse_block())
+        if len(res.queries) == n0:
+            raise ParseError("empty query body")
 
     def _parse_block(self) -> GraphQuery:
         gq = GraphQuery()
@@ -284,6 +287,15 @@ class _Parser:
                     if key in ("orderasc", "orderdesc"):
                         while self.accept("punct", "@"):
                             v += "@" + self.expect("name").text
+                    elif key in ("first", "offset", "after", "depth", "numpaths"):
+                        # integer args validate at parse time (parser.go:360
+                        # "Expected an int but got %v")
+                        try:
+                            int(v, 0)
+                        except ValueError:
+                            raise ParseError(
+                                f"expected an int for {key}: but got {v!r}"
+                            )
                     gq.args[key] = v
             else:
                 # unknown args are ignored (reference ignores xid:, etc.)
@@ -308,6 +320,8 @@ class _Parser:
         fn.name = self.expect("name").text.lower()
         self.expect("punct", "(")
         if fn.name == "uid":
+            if self.peek().text == ")":  # uid() — "Empty Argument"
+                raise ParseError("uid() needs at least one uid or variable")
             while not self.accept("punct", ")"):
                 self.accept("punct", ",")
                 if self.peek().text == ")":
@@ -395,7 +409,7 @@ class _Parser:
     def _parse_filter(self) -> Optional[FilterTree]:
         self.expect("punct", "(")
         if self.accept("punct", ")"):
-            return None
+            raise ParseError("empty @filter()")  # lex "Empty Argument"
         tree = self._parse_filter_or()
         self.expect("punct", ")")
         return tree
@@ -511,15 +525,17 @@ class _Parser:
                 spec.order_key = self.expect("name").text
                 spec.order_desc = t.text == "orderdesc"
             elif t.kind == "name":
-                # facet key, possibly "v as key", possibly a filter function
+                # facet key, possibly "v as key", possibly a filter tree
                 if self.peek(1).kind == "name" and self.peek(1).text.lower() == "as":
                     v = self.next().text
                     self.next()
                     key = self.expect("name").text
                     spec.keys.append(key)
                     spec.aliases[key] = v
-                elif self.peek(1).text == "(":
-                    # facet filter tree: @facets(eq(close, true))
+                elif self.peek(1).text == "(" or t.text.lower() == "not":
+                    # facet filter tree: @facets(eq(close, true)) — the
+                    # reference reverts to parseFilter when the content
+                    # is not a key list, which also admits leading NOT
                     gq.facets_filter = self._parse_filter_or()
                     break
                 else:
@@ -778,8 +794,18 @@ class _Parser:
             needs: List[str] = []
             self._walk_vars(q, defines, needs, is_root=True)
             res.query_vars.append((defines, needs))
-        # error on undefined vars across the request (checkDependency:605)
-        all_defs = {d for ds, _ in res.query_vars for d in ds}
+        # checkDependency (gql/parser.go:605): undefined uses, duplicate
+        # definitions, and defined-but-unused vars are all request errors
+        flat_defs = [d for ds, _ in res.query_vars for d in ds]
+        all_defs = set(flat_defs)
+        if len(flat_defs) != len(all_defs):
+            raise ParseError("some variables are declared multiple times")
+        all_needs = {n for _ds, ns in res.query_vars for n in ns}
+        unused = all_defs - all_needs
+        if unused:
+            raise ParseError(
+                f"some variables are defined but not used: {sorted(unused)}"
+            )
         for q, (_ds, ns) in zip(res.queries, res.query_vars):
             for n in ns:
                 if n not in all_defs:
@@ -915,6 +941,7 @@ def _extract_mutation(text: str) -> Tuple[str, Optional[Mutation]]:
     body = text[open_idx + 1 : close_idx]
     mu = Mutation()
     pos = 0
+    spans = []
     while True:
         sm = _SECTION_RE.search(body, pos)
         if sm is None:
@@ -929,7 +956,19 @@ def _extract_mutation(text: str) -> Tuple[str, Optional[Mutation]]:
             mu.del_nquads = content
         else:
             mu.schema = content
+        spans.append((sm.start(), c + 1))
         pos = c + 1
+    # anything outside the recognized sections is an unknown operation
+    # (the reference lexer errors "Invalid operation type")
+    residue = "".join(
+        body[(0 if i == 0 else spans[i - 1][1]) : s]
+        for i, (s, _e) in enumerate(spans)
+    ) + (body[spans[-1][1] :] if spans else body)
+    residue = re.sub(r"#[^\n]*", "", residue)  # comments between sections
+    if residue.strip():
+        raise ParseError(
+            f"unknown mutation section near {residue.strip()[:30]!r}"
+        )
     rest = text[: m.start()] + text[close_idx + 1 :]
     return rest, mu
 

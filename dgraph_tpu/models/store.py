@@ -35,7 +35,8 @@ class Edge:
 class PredicateData:
     """All postings for one predicate: uid edges and/or values."""
 
-    __slots__ = ("edges", "values", "edge_facets", "value_facets")
+    __slots__ = ("edges", "values", "edge_facets", "value_facets",
+                 "_has_langs")  # lazy lang-presence flag (functions.py)
 
     def __init__(self):
         # src uid -> set of dst uids
@@ -122,6 +123,13 @@ class PostingStore:
         if e.op == "set":
             if e.value is not None:
                 p.values[(e.src, e.lang)] = e.value
+                if e.lang:
+                    # invalidate the lazy lang-presence flag (functions.py
+                    # caches it on this live object)
+                    try:
+                        del p._has_langs
+                    except AttributeError:
+                        pass
                 if e.facets:
                     p.value_facets[e.src] = dict(e.facets)
             else:
@@ -132,6 +140,11 @@ class PostingStore:
             if e.value is not None or e.dst == 0:
                 p.values.pop((e.src, e.lang), None)
                 p.value_facets.pop(e.src, None)
+                if e.lang:
+                    try:
+                        del p._has_langs
+                    except AttributeError:
+                        pass
             else:
                 s = p.edges.get(e.src)
                 if s is not None:

@@ -198,11 +198,11 @@ long nq_scan(const char* buf, long len, long max_quads,
         } else if (pos < len && buf[pos] == '*') {
             ps = static_cast<int32_t>(pos); pe = static_cast<int32_t>(++pos);
             fl |= F_PRED_STAR;
-        } else if (pos < len && is_pred_start(buf[pos])) {
-            ps = static_cast<int32_t>(pos);
-            while (pos < len && is_pred_char(buf[pos])) ++pos;
-            pe = static_cast<int32_t>(pos);
         } else {
+            // predicates are IRIREF (or *) only — the reference lexer
+            // rejects bare names ("The predicate can only be an IRI",
+            // rdf/state.go:249); bare-pred acceptance would let typo'd
+            // quads silently create new predicates
             return -(stmt_start + 1);
         }
         if (pos < len && !is_ws(buf[pos])) return -(stmt_start + 1);  // \s+ again

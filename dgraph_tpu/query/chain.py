@@ -41,8 +41,15 @@ from dgraph_tpu.ops.sets import SENT
 # would dispatch to the device anyway, one fused dispatch strictly beats
 # one per level; below it, host numpy wins on transport latency.
 CHAIN_THRESHOLD = int(os.environ.get("DGRAPH_TPU_CHAIN_THRESHOLD", 262144))
-# abandon plans whose per-level output would exceed this many chunks
+# abandon plans whose per-level output would exceed this many chunks.
+# Full-mode chains transfer their matrices, so the cap is transfer-sized;
+# light-mode (var-block) chains keep matrices on device and only ship
+# frontiers — they can afford much larger device buffers (a 2^23-chunk
+# level is 256MB of HBM but ~2MB on the wire).
 CHAIN_MAX_CAPC = int(os.environ.get("DGRAPH_TPU_CHAIN_MAX_CAPC", 1 << 21))
+CHAIN_MAX_CAPC_LIGHT = int(
+    os.environ.get("DGRAPH_TPU_CHAIN_MAX_CAPC_LIGHT", 1 << 23)
+)
 
 
 def eligible_level(engine, sg) -> bool:
@@ -169,6 +176,13 @@ def try_run_chain(engine, child, src: np.ndarray) -> bool:
         est_u = lvl
     if est_total < engine.chain_threshold:
         return False
+    # var blocks encode nothing, so result matrices never leave the device
+    # (unless a level participates in @cascade, which prunes matrices)
+    light = bool(
+        getattr(engine, "_cur_block_internal", False)
+        and not any(sg.params.cascade for sg in levels)
+    )
+    max_capc = CHAIN_MAX_CAPC_LIGHT if light else CHAIN_MAX_CAPC
     caps: List[Tuple[int, int, bool]] = []
     m = len(src)  # bound on the unique frontier entering each level
     for i, a in enumerate(arenas):
@@ -177,7 +191,7 @@ def try_run_chain(engine, child, src: np.ndarray) -> bool:
         else:
             capc = int(_topm_chunk_sum(a, m))
         capc = ops.bucket(max(1, capc))
-        if capc > CHAIN_MAX_CAPC:
+        if capc > max_capc:
             return False
         # unique next-frontier ≤ total output slots, ≤ the arena's distinct
         # target count (NOT the source-uid universe: row-less leaf uids
@@ -201,13 +215,6 @@ def try_run_chain(engine, child, src: np.ndarray) -> bool:
         metas.append(m8)
         cdsts.append(cd)
         luts.append(a.lut(universe))
-
-    # var blocks encode nothing, so result matrices never leave the device
-    # (unless a level participates in @cascade, which prunes matrices)
-    light = bool(
-        getattr(engine, "_cur_block_internal", False)
-        and not any(sg.params.cascade for sg in levels)
-    )
 
     root_vec = jnp.asarray(ops.pad_to(src, ops.bucket(max(1, len(src)))))
     packed = np.asarray(  # ONE device round trip for the whole chain

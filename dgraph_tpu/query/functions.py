@@ -166,8 +166,26 @@ class FuncResolver:
     def _ineq(self, fn: Function) -> np.ndarray:
         if not fn.args:
             raise QueryError(f"{fn.name} needs a value argument")
-        # eq may take multiple values (union)
-        vals = fn.args if fn.name == "eq" else fn.args[:1]
+        # eq may take multiple values — varargs or a bracket list, both
+        # meaning "any of" (gql parseFunction list args)
+        vals: List[str] = []
+        for raw in fn.args if fn.name == "eq" else fn.args[:1]:
+            if fn.name == "eq" and raw.startswith("["):
+                try:
+                    items = json.loads(raw)
+                except json.JSONDecodeError:
+                    vals.append(raw)
+                    continue
+                for x in items:
+                    # the bracket-list parser floats all numbers (geo
+                    # coords); integral floats must round-trip as ints or
+                    # int-typed predicates choke on "19.0"
+                    if isinstance(x, float) and x.is_integer():
+                        vals.append(str(int(x)))
+                    else:
+                        vals.append(str(x))
+                continue
+            vals.append(raw)
         out = _EMPTY
         for raw in vals:
             out = np.union1d(out, self._ineq_one(fn, raw))
@@ -205,12 +223,30 @@ class FuncResolver:
         else:  # gt
             lo, hi = idx.row_range(lo=token, lo_open=True)
         cand = self._expand_rows(idx.csr, np.arange(lo, hi))
-        if tk.lossy or fn.lang:
+        if tk.lossy or fn.lang or self._pred_has_langs(pred):
             # lossy buckets include near-misses; lang-tagged functions
-            # must verify the match against the TAGGED value only (the
-            # index spans every language, task.go:612-661 lang filters)
+            # must verify the match against the TAGGED value only; and an
+            # UNtagged function over a predicate with tagged values must
+            # re-check against the untagged value — the index spans every
+            # language (task.go:612-661 lang filters), so a tagged token
+            # can land inside the untagged comparison range
             cand = self._host_recheck(pred, cand, op, val, fn.lang)
         return cand
+
+    def _pred_has_langs(self, pred: str) -> bool:
+        """Does the predicate carry any lang-tagged values?  Cached on the
+        PredicateData snapshot (replaced wholesale on dirty refresh)."""
+        pd = self.store.peek(pred)
+        if pd is None:
+            return False
+        flag = getattr(pd, "_has_langs", None)
+        if flag is None:
+            flag = any(lang for (_u, lang) in pd.values.keys())
+            try:
+                pd._has_langs = flag
+            except AttributeError:
+                pass  # slotted/foreign store impl: recompute per call
+        return flag
 
     def _terms(self, fn: Function, tokenizer: str, all_of: bool) -> np.ndarray:
         if not fn.args:
@@ -246,12 +282,13 @@ class FuncResolver:
             raise QueryError("regexp needs a pattern")
         raw = fn.args[0]
         flags = 0
-        pat = raw
-        if raw.startswith("/"):
-            body, _, tail = raw[1:].rpartition("/")
-            pat = body
-            if "i" in tail:
-                flags |= re.IGNORECASE
+        if not raw.startswith("/") or "/" not in raw[1:]:
+            # reference requires /pattern/[flags] (parser.go regexp arg)
+            raise QueryError(f"regexp argument must be /pattern/: got {raw!r}")
+        body, _, tail = raw[1:].rpartition("/")
+        pat = body
+        if "i" in tail:
+            flags |= re.IGNORECASE
         try:
             rx = re.compile(pat, flags)
         except re.error as e:

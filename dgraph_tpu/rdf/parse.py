@@ -37,7 +37,7 @@ class NQuad:
 _QUAD_RE = re.compile(
     r"""\s*
     (?P<subj><[^>]*>|_:[A-Za-z0-9._\-]+|\*)\s+
-    (?P<pred><[^>]*>|[A-Za-z_][\w.\-]*|\*)\s+
+    (?P<pred><[^>]*>|\*)\s+
     (?P<obj><[^>]*>|_:[A-Za-z0-9._\-]+|"(?:\\.|[^"\\])*"(?:@[A-Za-z\-:]+|\^\^<[^>]*>)?|\*)
     (?:[^\S\n]+(?P<label><[^>]*>))?
     \s*(?:\((?P<facets>[^)]*)\))?

@@ -282,10 +282,10 @@ def _make_handler(srv: DgraphServer):
                     return self._err(404, "not clustered")
                 if not self._cluster_authorized():
                     return self._err(403, "cluster secret required")
-                from urllib.parse import parse_qs, unquote
+                from urllib.parse import parse_qs
 
-                qs = parse_qs(u.query)
-                name = unquote(qs.get("name", [""])[0])
+                qs = parse_qs(u.query)  # parse_qs already percent-decodes
+                name = qs.get("name", [""])[0]
                 since = int(qs.get("since", ["-1"])[0])
                 gid = srv.cluster.conf.belongs_to(name)
                 g = srv.cluster.groups.get(gid)

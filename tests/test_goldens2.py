@@ -1,0 +1,651 @@
+"""Golden suite II: the langs × facets × vars × order/pagination matrix
+plus parser error cases and traversal edge cases (VERDICT r3 item 5;
+checklist shape follows the reference's gql/parser_test.go 211 cases and
+query_test.go matrix, pinned on the ORIGINAL fixture of test_goldens).
+"""
+
+import pytest
+
+from dgraph_tpu.gql import ParseError, parse
+from dgraph_tpu.query.functions import QueryError
+from tests.test_goldens import RDF, SCHEMA, eng, q  # noqa: F401 (fixture)
+
+
+# ------------------------------------------------------------ parser errors
+# the reference pins ~211 parser cases (gql/parser_test.go); the error
+# half of that matrix, re-expressed:
+
+PARSER_ERRORS = [
+    # brackets / braces
+    "{ me(func: uid(0x1)) { name }",                     # unclosed block
+    "{ me(func: uid(0x1)) { name } } }",                 # extra brace
+    "{ me(func: uid(0x1) { name } }",                    # unclosed paren
+    "{ me(func: uid(0x1))) { name } }",                  # extra paren
+    "me(func: uid(0x1)) { name }",                       # no outer braces
+    "{ }",                                               # empty query
+    "{ me }",                                            # block without body
+    # func issues
+    "{ me(func:) { name } }",                            # empty func
+    "{ me(func: nosuchfunc(name, x)) { name } }",        # unknown func
+    "{ me(func: eq(name)) { name } }",                   # eq arity
+    "{ me(func: uid()) { name } }",                      # uid arity
+    "{ me(func: uid(zzz)) { name } }",                   # bad uid literal
+    "{ me(func: regexp(name, noslash)) { name } }",      # regexp not /../
+    "{ me(func: near(loc)) { name } }",                  # near arity
+    # filter trees
+    "{ me(func: uid(0x1)) @filter() { name } }",         # empty filter
+    "{ me(func: uid(0x1)) @filter(and) { name } }",      # dangling bool op
+    "{ me(func: uid(0x1)) @filter(eq(name, \"A\") and) { name } }",
+    "{ me(func: uid(0x1)) @filter(eq(name, \"A\") or or eq(name, \"B\")) { name } }",
+    "{ me(func: uid(0x1)) @filter(not) { name } }",
+    "{ me(func: uid(0x1)) @filter((eq(name, \"A\")) { name } }",  # unclosed
+    # directives
+    "{ me(func: uid(0x1)) @nosuchdirective { name } }",
+    "{ me(func: uid(0x1)) @ { name } }",
+    # pagination args
+    "{ me(func: uid(0x1), first: abc) { name } }",
+    "{ me(func: uid(0x1), offset: ) { name } }",
+    # order args
+    "{ me(func: uid(0x1), orderasc: ) { name } }",
+    # vars
+    "{ me(func: uid(x)) { name } }",                     # undefined var
+    "{ q1(func: uid(0x1)) { x as name } q2(func: uid(0x1)) { x as age } }",  # redefined
+    '{ var(func: uid(0x1)) { unused as name } me(func: uid(0x1)) { age } }',  # unused
+    # aggregation / math
+    "{ me(func: uid(0x1)) { min() } }",
+    "{ me(func: uid(0x1)) { math() } }",
+    "{ me(func: uid(0x1)) { x: math(1 +) } }",
+    # fragments
+    "{ me(func: uid(0x1)) { ...nosuchfragment } }",
+    # mutation blocks
+    "mutation { set { <0x1> <name> } }",                 # incomplete nquad
+    "mutation { set { <0x1> name \"x\" . } }",           # unbracketed pred
+    "mutation { nosuchop { } }",
+    "mutation { schema { name string . } }",             # missing colon
+    # groupby / facets
+    "{ me(func: uid(0x1)) { friend @groupby { name } } }",   # groupby needs attrs
+    "{ me(func: uid(0x1)) { friend @facets( { name } } }",   # unclosed facets
+    # shortest
+    "{ shortest(to: 0x2) { friend } }",                  # missing from
+    "{ shortest(from: 0x1) { friend } }",                # missing to
+    # GraphQL variables
+    "query t($a: int) { me(func: uid($b)) { name } }",   # undeclared use
+    # strings
+    '{ me(func: eq(name, "unterminated)) { name } }',
+]
+
+
+@pytest.mark.parametrize("bad", PARSER_ERRORS)
+def test_parser_rejects(bad, eng):
+    with pytest.raises((ParseError, QueryError, ValueError)):
+        # some malformations only surface at execution planning; both
+        # layers must reject with typed errors, never crash or silently
+        # succeed (checklist: reference gql/parser_test.go error half)
+        eng.run(bad)
+
+
+# ------------------------------------------------- order × pagination matrix
+
+
+def test_order_root_asc_int(eng):
+    got = q(eng, "{ me(func: has(age), orderasc: age) { name age } }")
+    assert [x["name"] for x in got["me"]] == [
+        "Bo", "Dodo", "Asha", "Cleo", "Ben", "Dan", "Ann", "Cara Lee",
+    ]
+
+
+def test_order_root_desc_int_first(eng):
+    got = q(eng, "{ me(func: has(age), orderdesc: age, first: 3) { name } }")
+    assert [x["name"] for x in got["me"]] == ["Cara Lee", "Ann", "Ben"]
+
+
+def test_order_root_offset_window(eng):
+    got = q(eng, "{ me(func: has(age), orderasc: age, offset: 2, first: 3) { name } }")
+    assert [x["name"] for x in got["me"]] == ["Asha", "Cleo", "Ben"]
+
+
+def test_order_offset_past_end(eng):
+    got = q(eng, "{ me(func: has(age), orderasc: age, offset: 50) { name } }")
+    assert got == {"me": []}
+
+
+def test_order_float_key(eng):
+    got = q(eng, "{ me(func: has(weight), orderasc: weight) { name weight } }")
+    assert [x["name"] for x in got["me"]] == ["Cara Lee", "Ann", "Ben"]
+
+
+def test_order_datetime_key_desc(eng):
+    got = q(eng, "{ me(func: has(dob), orderdesc: dob) { name } }")
+    assert [x["name"] for x in got["me"]] == ["Ben", "Ann", "Cara Lee"]
+
+
+def test_order_string_key(eng):
+    got = q(eng, "{ me(func: uid(0x1, 0x2, 0x3), orderdesc: name) { name } }")
+    assert [x["name"] for x in got["me"]] == ["Cara Lee", "Ben", "Ann"]
+
+
+def test_order_ties_stable_by_uid(eng):
+    # Ben (0x2) and Dan (0x4) both age 29: ties keep uid order
+    got = q(eng, "{ me(func: has(dob), orderasc: age) { name } }")
+    assert [x["name"] for x in got["me"]] == ["Ben", "Ann", "Cara Lee"]
+    got = q(eng, "{ me(func: uid(0x2, 0x4), orderasc: age) { name } }")
+    assert [x["name"] for x in got["me"]] == ["Ben", "Dan"]
+
+
+def test_order_child_with_pagination(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) {
+        cares_for (orderdesc: age, first: 2) { name age }
+    } }""")
+    assert got["me"][0]["cares_for"] == [
+        {"name": "Cleo", "age": 9},
+        {"name": "Asha", "age": 5},
+    ]
+
+
+def test_order_child_missing_values_last_asc(eng):
+    # Ember (0xe) has no age: missing sorts last ascending
+    got = q(eng, """
+    { me(func: uid(0x2)) { cares_for (orderasc: age) { name } } }""")
+    assert [x["name"] for x in got["me"][0]["cares_for"]] == ["Dodo", "Ember"]
+
+
+def test_order_child_missing_values_first_desc(eng):
+    got = q(eng, """
+    { me(func: uid(0x2)) { cares_for (orderdesc: age) { name } } }""")
+    assert [x["name"] for x in got["me"][0]["cares_for"]] == ["Ember", "Dodo"]
+
+
+def test_after_uid_pagination(eng):
+    got = q(eng, "{ me(func: uid(0x1)) { cares_for (after: 0xa) { name } } }")
+    assert [x["name"] for x in got["me"][0]["cares_for"]] == ["Bo", "Cleo"]
+
+
+def test_after_with_first(eng):
+    got = q(eng, "{ me(func: uid(0x1)) { cares_for (after: 0xa, first: 1) { name } } }")
+    assert [x["name"] for x in got["me"][0]["cares_for"]] == ["Bo"]
+
+
+def test_first_negative_takes_from_end(eng):
+    # reference semantics: negative first = last N (applyPagination)
+    got = q(eng, "{ me(func: has(age), orderasc: age, first: -2) { name } }")
+    assert [x["name"] for x in got["me"]] == ["Ann", "Cara Lee"]
+
+
+# ------------------------------------------------------------- langs matrix
+
+
+def test_lang_order_untagged_key(eng):
+    # order uses untagged names even when display is tagged
+    got = q(eng, '{ me(func: uid(0x1, 0x2), orderasc: name) { name@ru } }')
+    assert [x.get("name@ru") for x in got["me"]] == ["Анна", "Бен"]
+
+
+def test_lang_any_dot_prefers_untagged(eng):
+    got = q(eng, '{ me(func: uid(0x1)) { name@. } }')
+    assert got == {"me": [{"name@.": "Ann"}]}
+
+
+def test_lang_filter_eq_tagged(eng):
+    got = q(eng, '{ me(func: eq(name@ru, "Анна")) { name } }')
+    assert got == {"me": [{"name": "Ann"}]}
+
+
+def test_lang_chain_with_expand_leaf(eng):
+    got = q(eng, '{ me(func: uid(0x4)) { friend { name@ru:hu } } }')
+    assert got["me"][0]["friend"] == [{"name@ru:hu": "Анна"}]
+
+
+def test_lang_in_normalize(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) @normalize { n: name@hu friend { f: name } } }""")
+    assert got["me"] == [
+        {"n": "Anna", "f": "Ben"},
+        {"n": "Anna", "f": "Cara Lee"},
+    ]
+
+
+# ------------------------------------------------------------ facets matrix
+
+
+def test_facet_output_multiple_keys(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) { cares_for @facets(since, level) { name } } }""")
+    pets = got["me"][0]["cares_for"]
+    asha = next(p for p in pets if p["name"] == "Asha")
+    assert asha["@facets"]["_"]["level"] == 3
+    assert asha["@facets"]["_"]["since"].startswith("2019-04-01")
+
+
+def test_facet_filter_ge(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) { cares_for @facets(ge(level, 2)) { name } } }""")
+    assert sorted(x["name"] for x in got["me"][0]["cares_for"]) == ["Asha", "Cleo"]
+
+
+def test_facet_filter_and(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) {
+        cares_for @facets(ge(level, 1) and le(level, 2)) { name }
+    } }""")
+    assert sorted(x["name"] for x in got["me"][0]["cares_for"]) == ["Bo", "Cleo"]
+
+
+def test_facet_filter_not(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) { cares_for @facets(not eq(level, 3)) { name } } }""")
+    assert sorted(x["name"] for x in got["me"][0]["cares_for"]) == ["Bo", "Cleo"]
+
+
+def test_facet_filter_missing_key_excludes(eng):
+    # 0x2's edge to Ember has no facets: filtered edges require the key
+    got = q(eng, """
+    { me(func: uid(0x2)) { cares_for @facets(ge(level, 0)) { name } } }""")
+    assert [x["name"] for x in got["me"][0]["cares_for"]] == ["Dodo"]
+
+
+def test_facet_order_asc(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) { cares_for @facets(orderasc: level) { name } } }""")
+    assert [x["name"] for x in got["me"][0]["cares_for"]] == ["Bo", "Cleo", "Asha"]
+
+
+def test_facet_order_desc_datetime(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) { cares_for @facets(orderdesc: since) { name } } }""")
+    assert [x["name"] for x in got["me"][0]["cares_for"]] == ["Bo", "Cleo", "Asha"]
+
+
+def test_facet_var_binding(eng):
+    got = q(eng, """
+    {
+      var(func: uid(0x1)) { cares_for @facets(L as level) }
+      me(func: uid(0xa, 0xb, 0xc), orderdesc: val(L)) { name val(L) }
+    }""")
+    assert got["me"] == [
+        {"name": "Asha", "val(L)": 3},
+        {"name": "Cleo", "val(L)": 2},
+        {"name": "Bo", "val(L)": 1},
+    ]
+
+
+def test_facet_key_list_subset(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) { cares_for @facets(level) { name } } }""")
+    pets = got["me"][0]["cares_for"]
+    asha = next(p for p in pets if p["name"] == "Asha")
+    assert asha["@facets"]["_"] == {"level": 3}  # 'since' not requested
+
+
+def test_facets_on_reverse_edge(eng):
+    got = q(eng, """
+    { me(func: uid(0xa)) { ~cares_for @facets(level) { name } } }""")
+    keepers = got["me"][0]["~cares_for"]
+    ann = next(k for k in keepers if k["name"] == "Ann")
+    assert ann["@facets"]["_"]["level"] == 3
+
+
+# --------------------------------------------------------------- var chains
+
+
+def test_var_chain_two_blocks(eng):
+    got = q(eng, """
+    {
+      var(func: uid(0x1)) { f as friend }
+      var(func: uid(f)) { ff as friend }
+      me(func: uid(ff), orderasc: name) { name }
+    }""")
+    assert [x["name"] for x in got["me"]] == ["Cara Lee", "Dan"]
+
+
+def test_var_union_of_two_vars(eng):
+    got = q(eng, """
+    {
+      var(func: uid(0x1)) { a as friend }
+      var(func: uid(0x3)) { b as friend }
+      me(func: uid(a, b), orderasc: name) { name }
+    }""")
+    assert [x["name"] for x in got["me"]] == ["Ben", "Cara Lee", "Dan"]
+
+
+def test_var_in_filter(eng):
+    got = q(eng, """
+    {
+      var(func: uid(0x1)) { f as friend }
+      me(func: has(age)) @filter(uid(f)) { name }
+    }""")
+    assert sorted(x["name"] for x in got["me"]) == ["Ben", "Cara Lee"]
+
+
+def test_value_var_sum_across_block(eng):
+    got = q(eng, """
+    {
+      var(func: uid(0x1)) { cares_for { a as age } }
+      total() { s: sum(val(a)) }
+    }""")
+    assert got["total"] == [{"s": 16.0}]
+
+
+def test_value_var_math_chain(eng):
+    got = q(eng, """
+    {
+      var(func: uid(0x1)) { cares_for { a as age b as math(a + 10) } }
+      me(func: uid(0xa), orderasc: name) { name val(b) }
+    }""")
+    assert got["me"] == [{"name": "Asha", "val(b)": 15.0}]
+
+
+def test_value_var_order_pagination_combo(eng):
+    got = q(eng, """
+    {
+      var(func: has(age)) { a as age }
+      me(func: uid(a), orderdesc: val(a), first: 3) { name age }
+    }""")
+    assert [x["name"] for x in got["me"]] == ["Cara Lee", "Ann", "Ben"]
+
+
+def test_count_var_in_order(eng):
+    got = q(eng, """
+    {
+      var(func: has(cares_for)) { c as count(cares_for) }
+      me(func: uid(c), orderdesc: val(c)) { name val(c) }
+    }""")
+    assert got["me"] == [
+        {"name": "Ann", "val(c)": 3},
+        {"name": "Ben", "val(c)": 2},
+        {"name": "Cara Lee", "val(c)": 1},
+    ]
+
+
+def test_var_through_reverse_edge(eng):
+    got = q(eng, """
+    {
+      var(func: uid(0xa)) { k as ~cares_for }
+      me(func: uid(k), orderasc: name) { name }
+    }""")
+    assert [x["name"] for x in got["me"]] == ["Ann", "Cara Lee"]
+
+
+# ---------------------------------------------------- shortest/recurse edge
+
+
+def test_shortest_no_path(eng):
+    got = q(eng, "{ shortest(from: 0xa, to: 0x1) { friend } }")
+    assert got.get("_path_", []) == []
+
+
+def test_shortest_self(eng):
+    got = q(eng, "{ shortest(from: 0x1, to: 0x1) { friend } }")
+    path = got.get("_path_", [])
+    assert path == [] or path[0].get("_uid_") == "0x1"
+
+
+def test_shortest_two_hop(eng):
+    got = q(eng, "{ shortest(from: 0x1, to: 0x4) { friend } }")
+    p = got["_path_"][0]
+    assert p["_uid_"] == "0x1"
+    assert p["friend"][0]["_uid_"] == "0x3"
+    assert p["friend"][0]["friend"][0]["_uid_"] == "0x4"
+
+
+def test_k_shortest_counts(eng):
+    got = q(eng, "{ shortest(from: 0x1, to: 0x4, numpaths: 2) { friend } }")
+    assert len(got["_path_"]) == 2
+
+
+def test_recurse_depth_one(eng):
+    got = q(eng, "{ recurse(func: uid(0x1), depth: 1) { name friend } }")
+    me = got["recurse"][0]
+    assert me["name"] == "Ann"
+    assert "friend" not in me or all("friend" not in f for f in me.get("friend", []))
+
+
+def test_recurse_cycle_terminates(eng):
+    # 0x1 -> 0x2 -> 0x3 -> 0x4 -> 0x1 is a cycle; dedup must terminate it
+    got = q(eng, "{ recurse(func: uid(0x1), depth: 10) { name friend } }")
+    assert got["recurse"][0]["name"] == "Ann"
+
+
+def test_recurse_multiple_preds(eng):
+    got = q(eng, "{ recurse(func: uid(0x2), depth: 2) { name cares_for pet } }")
+    me = got["recurse"][0]
+    names = {x.get("name") for x in me.get("cares_for", [])}
+    assert names == {"Dodo", "Ember"}
+
+
+# ------------------------------------------------------- assorted behaviors
+
+
+def test_filter_on_root_combined_with_func(eng):
+    got = q(eng, """
+    { me(func: has(age)) @filter(ge(age, 30) and lt(age, 41)) { name } }""")
+    assert sorted(x["name"] for x in got["me"]) == ["Ann", "Cara Lee"]
+
+
+def test_uid_in_function(eng):
+    got = q(eng, """
+    { me(func: has(age)) @filter(uid_in(friend, 0x3)) { name } }""")
+    assert sorted(x["name"] for x in got["me"]) == ["Ann", "Ben"]
+
+
+def test_checkpwd(eng):
+    got = q(eng, '{ me(func: uid(0x4)) { checkpwd(pwd, "hunter2") } }')
+    assert got["me"][0]["pwd"] == [{"checkpwd": True}]
+    got = q(eng, '{ me(func: uid(0x4)) { checkpwd(pwd, "wrong") } }')
+    assert got["me"][0]["pwd"] == [{"checkpwd": False}]
+
+
+def test_alias_on_count(eng):
+    got = q(eng, "{ me(func: uid(0x1)) { total: count(cares_for) } }")
+    assert got == {"me": [{"total": 3}]}
+
+
+def test_multiple_blocks_same_name_merge(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) { name } me(func: uid(0x2)) { name } }""")
+    assert [x["name"] for x in got["me"]] == ["Ann", "Ben"]
+
+
+def test_cascade_with_pagination(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) @cascade {
+        cares_for (orderasc: age, first: 2) { name age }
+    } }""")
+    kids = got["me"][0]["cares_for"]
+    assert [x["name"] for x in kids] == ["Bo", "Asha"]
+
+
+def test_normalize_with_facets(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) @normalize {
+        cares_for @facets(ge(level, 3)) { pn: name }
+    } }""")
+    assert got["me"] == [{"pn": "Asha"}]
+
+
+def test_groupby_with_order_context(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) { cares_for @groupby(age) { count(_uid_) } } }""")
+    groups = got["me"][0]["cares_for"][0]["@groupby"]
+    assert {"age": 2, "count": 1} in groups
+    assert {"age": 5, "count": 1} in groups
+    assert {"age": 9, "count": 1} in groups
+
+
+def test_count_at_root_of_filtered(eng):
+    got = q(eng, "{ me(func: has(cares_for)) @filter(gt(count(cares_for), 1)) { count() } }")
+    assert got == {"me": [{"count": 2}]}
+
+
+def test_has_on_value_pred(eng):
+    got = q(eng, "{ me(func: has(weight), orderasc: name) { name } }")
+    assert [x["name"] for x in got["me"]] == ["Ann", "Ben", "Cara Lee"]
+
+
+def test_between_style_inequality_chain(eng):
+    got = q(eng, "{ me(func: ge(age, 29)) @filter(le(age, 31)) { name } }")
+    assert sorted(x["name"] for x in got["me"]) == ["Ann", "Ben", "Dan"]
+
+
+def test_anyofterms_multi_token(eng):
+    got = q(eng, '{ me(func: anyofterms(name, "lee bo")) { name } }')
+    assert sorted(x["name"] for x in got["me"]) == ["Ann Lee", "Bo", "Cara Lee"]
+
+
+def test_allofterms(eng):
+    got = q(eng, '{ me(func: allofterms(name, "ann lee")) { name } }')
+    assert [x["name"] for x in got["me"]] == ["Ann Lee"]
+
+
+def test_eq_multiple_args_is_in(eng):
+    got = q(eng, '{ me(func: eq(name, ["Ann", "Ben"]), orderasc: name) { name } }')
+    assert [x["name"] for x in got["me"]] == ["Ann", "Ben"]
+
+
+# ------------------------------------------------ combined-dimension cells
+
+
+def test_lang_with_facets_on_same_edge(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) { cares_for @facets(level) { name@ru:hu } } }""")
+    # animals have no tagged names: leaf absent, facets still attach
+    pets = got["me"][0]["cares_for"]
+    assert all("name@ru:hu" not in p for p in pets)
+    assert any(p.get("@facets", {}).get("_", {}).get("level") == 3 for p in pets)
+
+
+def test_facet_order_with_pagination(eng):
+    got = q(eng, """
+    { me(func: uid(0x1)) {
+        cares_for (first: 2) @facets(orderdesc: level) { name }
+    } }""")
+    assert [x["name"] for x in got["me"][0]["cares_for"]] == ["Asha", "Cleo"]
+
+
+def test_var_order_by_facet_var_chain(eng):
+    got = q(eng, """
+    {
+      var(func: uid(0x1)) { cares_for @facets(S as since) }
+      me(func: uid(0xa, 0xb, 0xc), orderasc: val(S)) { name }
+    }""")
+    assert [x["name"] for x in got["me"]] == ["Asha", "Cleo", "Bo"]
+
+
+def test_multi_var_math_combination(eng):
+    got = q(eng, """
+    {
+      var(func: has(weight)) { w as weight a as age
+        bmiish as math(w / (a / 10.0)) }
+      me(func: uid(bmiish), orderdesc: val(bmiish), first: 1) { name }
+    }""")
+    assert got["me"][0]["name"] == "Ben"
+
+
+def test_recurse_with_value_leaf_langs(eng):
+    got = q(eng, "{ recurse(func: uid(0x4), depth: 2) { name@ru friend } }")
+    me = got["recurse"][0]
+    assert me.get("name@ru") is None or isinstance(me.get("name@ru"), str)
+    lvl1 = {x.get("name@ru") for x in me.get("friend", [])}
+    assert "Анна" in lvl1
+
+
+def test_groupby_value_pred(eng):
+    got = q(eng, """
+    { me(func: has(age)) @groupby(age) { count(_uid_) } }""")
+    groups = got["me"][0]["@groupby"]
+    assert {"age": 29, "count": 2} in groups
+    assert {"age": 2, "count": 2} in groups
+
+
+def test_reverse_count_leaf(eng):
+    got = q(eng, "{ me(func: uid(0xa)) { count(~cares_for) } }")
+    assert got == {"me": [{"count(~cares_for)": 2}]}
+
+
+def test_expand_all_with_pagination_context(eng):
+    got = q(eng, "{ me(func: uid(0xb)) { expand(_all_) } }")
+    me = got["me"][0]
+    assert me["name"] == "Bo" and me["age"] == 2
+
+
+def test_normalize_cascade_combo(eng):
+    got = q(eng, """
+    { me(func: uid(0x2)) @cascade @normalize {
+        cares_for { pn: name pa: age }
+    } }""")
+    # Ember has no age: cascade drops it; normalize flattens the rest
+    assert got["me"] == [{"pn": "Dodo", "pa": 2}]
+
+
+def test_shortest_then_query_block(eng):
+    got = q(eng, """
+    {
+      path as shortest(from: 0x1, to: 0x4) { friend }
+      me(func: uid(path), orderasc: name) { name }
+    }""")
+    assert [x["name"] for x in got["me"]] == ["Ann", "Cara Lee", "Dan"]
+
+
+def test_string_ineq_on_exact_index(eng):
+    got = q(eng, '{ me(func: ge(name, "Ben"), orderasc: name) { name } }')
+    assert [x["name"] for x in got["me"]] == [
+        "Ben", "Bo", "Cara Lee", "Cleo", "Dan", "Dodo", "Ember",
+    ]
+
+
+def test_datetime_year_bucket_eq(eng):
+    got = q(eng, '{ me(func: eq(dob, "1990-05-02")) { name } }')
+    assert got == {"me": [{"name": "Ann"}]}
+
+
+def test_bool_index(eng):
+    got = q(eng, '{ me(func: eq(wild, true)) { name } }')
+    assert got == {"me": [{"name": "Asha"}]}
+
+
+def test_float_ineq_lt(eng):
+    got = q(eng, '{ me(func: lt(weight, 62.5), orderasc: name) { name } }')
+    assert [x["name"] for x in got["me"]] == ["Cara Lee"]
+
+
+def test_term_index_case_insensitive(eng):
+    got = q(eng, '{ me(func: anyofterms(name, "CARA")) { name } }')
+    assert got == {"me": [{"name": "Cara Lee"}]}
+
+
+def test_lang_flag_invalidates_on_mutation():
+    """Adding a tagged value AFTER an untagged inequality query must not
+    leave a stale langless flag serving tagged leaks (regression)."""
+    from dgraph_tpu.models import PostingStore
+    from dgraph_tpu.query import QueryEngine
+
+    e = QueryEngine(PostingStore())
+    e.run('mutation { schema { name: string @index(exact) . } '
+          'set { <0x1> <name> "Mid" . } }')
+    got = e.run('{ q(func: ge(name, "Zzz")) { name } }')
+    assert got == {"q": []}
+    # tagged value sorting above the bound appears: must stay excluded
+    e.run('mutation { set { <0x1> <name> "Яя"@ru . } }')
+    got = e.run('{ q(func: ge(name, "Zzz")) { name } }')
+    assert got == {"q": []}
+
+
+def test_mutation_comments_between_sections():
+    from dgraph_tpu.models import PostingStore
+    from dgraph_tpu.query import QueryEngine
+
+    e = QueryEngine(PostingStore())
+    e.run("""mutation {
+      # seed the schema
+      schema { name: string @index(exact) . }
+      # and one person
+      set { <0x1> <name> "Zed" . }
+    }""")
+    assert e.run('{ q(func: eq(name, "Zed")) { name } }') == {
+        "q": [{"name": "Zed"}]
+    }
+
+
+def test_eq_int_list(eng):
+    got = q(eng, "{ me(func: eq(age, [29, 40]), orderasc: name) { name } }")
+    assert [x["name"] for x in got["me"]] == ["Ben", "Cara Lee", "Dan"]

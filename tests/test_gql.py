@@ -171,6 +171,7 @@ def test_count_child_and_count_var():
         count(friends)
         n as count(relatives)
       }
+      also(func: uid(n)) { name }
     }""")
     c0, c1 = res.queries[0].children
     assert c0.is_count and c0.attr == "friends"
@@ -188,6 +189,7 @@ def test_math_tree():
           d as math(a + b * c / a + exp(a + b + 1) - ln(c))
         }
       }
+      me(func: uid(L), orderasc: val(d)) { name }
     }""")
     d = res.queries[0].children[0].children[3]
     assert d.var == "d"
@@ -204,6 +206,7 @@ def test_math_cond():
           d as math(cond(a <= 10, exp(a + 1), ln(a)) + 10*a)
         }
       }
+      me(func: uid(f), orderasc: val(d)) { name }
     }""")
     d = res.queries[0].children[0].children[1]
     assert d.math_exp.fn == "+"
@@ -264,6 +267,7 @@ def test_facets():
         hometown @facets
         school @facets(since, a as established)
       }
+      uses(func: uid(0x2), orderasc: val(a)) { name }
     }""")
     c = res.queries[0].children
     assert c[0].facets.order_key == "closeness" and c[0].facets.order_desc
