@@ -163,6 +163,78 @@ class CSRArena:
         hit = self.h_src[pos] == uids
         return np.where(hit, pos, -1)
 
+    # -- incremental refresh (gentle-commit analog) -------------------------
+
+    _device_stale: bool = False
+
+    def apply_delta(self, adds: np.ndarray, dels: np.ndarray) -> None:
+        """Apply a small mutation batch to the HOST mirrors in place of a
+        full rebuild: O(E) memcpy via np.insert/np.delete instead of the
+        O(E log E) lexsort + dict flatten of csr_from_edges — the
+        incremental counterpart of the reference's mutation layer merge
+        (posting/list.go:321-410).  Device tensors go stale and re-upload
+        lazily on the next device-path use (ensure_device) — host-routed
+        queries after a point mutation never touch the device at all.
+
+        adds/dels: int64[n, 2] (src, dst) arrays; adds must not already
+        exist, dels must exist (the store journal guarantees both).
+        """
+        h_dst = self.host_dst().astype(np.int64, copy=False)
+        # absolute edge positions via the composite (row, dst) key — the
+        # CSR flat dst IS sorted by it
+        for arr, sign in ((dels, -1), (adds, +1)):
+            if not len(arr):
+                continue
+            srcs = arr[:, 0]
+            dsts = arr[:, 1]
+            if sign > 0:
+                # new source rows first (degree 0), keeping h_src sorted
+                newsrc = np.setdiff1d(srcs, self.h_src)
+                if len(newsrc):
+                    at = np.searchsorted(self.h_src, newsrc)
+                    self.h_src = np.insert(self.h_src, at, newsrc)
+                    self.h_offsets = np.insert(
+                        self.h_offsets, at + 1, self.h_offsets[at]
+                    )
+                    self.n_rows = len(self.h_src)
+            rows = np.searchsorted(self.h_src, srcs)
+            keys = (rows.astype(np.int64) << 32) | dsts
+            edge_rows = np.repeat(
+                np.arange(self.n_rows, dtype=np.int64),
+                np.diff(self.h_offsets),
+            )
+            edge_keys = (edge_rows << 32) | h_dst
+            order = np.argsort(keys, kind="stable")
+            keys, rows, dsts = keys[order], rows[order], dsts[order]
+            pos = np.searchsorted(edge_keys, keys)
+            if sign > 0:
+                h_dst = np.insert(h_dst, pos, dsts)
+            else:
+                h_dst = np.delete(h_dst, pos)
+            cnt = np.bincount(rows, minlength=self.n_rows)
+            self.h_offsets = self.h_offsets.copy()
+            self.h_offsets[1:] += sign * np.cumsum(cnt)
+        self._h_dst = h_dst.astype(np.int32)
+        self.n_edges = len(h_dst)
+        # derived device structures are stale until next device use
+        self._chunked = None
+        self._lut = None
+        self._n_distinct_dst = None
+        if hasattr(self, "_topm_cdeg"):
+            del self._topm_cdeg
+        self._device_stale = True
+
+    def ensure_device(self) -> None:
+        """Re-upload device tensors from the host mirrors if a delta made
+        them stale (one upload amortizes a burst of point mutations)."""
+        if not self._device_stale:
+            return
+        fresh = _csr_from_arrays(self.h_src, self.h_offsets, self._h_dst)
+        self.src = fresh.src
+        self.offsets = fresh.offsets
+        self.dst = fresh.dst
+        self._device_stale = False
+
 
 def _build_csr(rows_to_dsts: Dict[int, np.ndarray]) -> CSRArena:
     """Build a CSR arena from {row_key: array-of-dst} (host)."""
@@ -345,7 +417,12 @@ class ArenaManager:
         self._sharded: Dict[Tuple[str, bool], tuple] = {}
 
     def refresh(self):
-        """Drop cached arenas for predicates mutated since last refresh."""
+        """Drop or incrementally update cached arenas for predicates
+        mutated since last refresh.  Small uid-edge deltas (the store's
+        bounded journal) update cached data/reverse arenas in place —
+        the gentle-commit amortization (posting/lists.go:109-215) — while
+        value mutations, bulk loads and journal overflow fall back to the
+        full rebuild."""
         dirty = self.store.dirty
         if not dirty:
             return
@@ -356,8 +433,14 @@ class ArenaManager:
             self._index.clear()
             self._sharded.clear()
             dirty.clear()
+            getattr(self.store, "delta", {}).clear()
             return
+        deltas = getattr(self.store, "delta", {})
         for p in list(dirty):
+            delta = deltas.pop(p, None)
+            if delta is not None and self._try_apply_delta(p, delta):
+                dirty.discard(p)
+                continue
             for key in [k for k in self._data if k == p or k.startswith(p + "\x00")]:
                 self._data.pop(key, None)
             self._reverse.pop(p, None)
@@ -367,6 +450,39 @@ class ArenaManager:
             for key in [k for k in self._index if k[0] == p]:
                 self._index.pop(key, None)
         dirty.clear()
+        deltas.clear()
+
+    def _try_apply_delta(self, pred: str, delta: list) -> bool:
+        """Incrementally update the cached data (and reverse) arena for
+        ``pred``.  Returns False when no cached arena exists (nothing to
+        update — the next access builds fresh anyway) or a has-rows
+        variant is cached (its row universe can shift: full rebuild)."""
+        a = self._data.get(pred)
+        if a is None or (pred + "\x00has") in self._data:
+            return False
+        if (pred, False) in self._sharded or (pred, True) in self._sharded:
+            return False  # mesh-sharded copies rebuild wholesale
+        if not delta:
+            return True  # facet-only touches: arenas unaffected
+        # row-garbage bound: repeated delete churn leaves degree-0 rows
+        # that only a full rebuild reclaims; rebuild once they dominate
+        zero_rows = int(np.count_nonzero(np.diff(a.h_offsets) == 0))
+        if zero_rows > max(4096, a.n_rows // 4):
+            return False
+        net: Dict[Tuple[int, int], int] = {}
+        for s, d, sign in delta:
+            net[(s, d)] = net.get((s, d), 0) + sign
+        adds = np.array(
+            [k for k, v in net.items() if v > 0], dtype=np.int64
+        ).reshape(-1, 2)
+        dels = np.array(
+            [k for k, v in net.items() if v < 0], dtype=np.int64
+        ).reshape(-1, 2)
+        a.apply_delta(adds, dels)
+        r = self._reverse.get(pred)
+        if r is not None:
+            r.apply_delta(adds[:, ::-1], dels[:, ::-1])
+        return True
 
     # -- mesh sharding -------------------------------------------------------
 
@@ -383,7 +499,7 @@ class ArenaManager:
             return cached[1]
         n_model = self.mesh.shape["model"]
         sa = shard_arena_rows(
-            a.h_src, a.h_offsets, np.asarray(a.dst)[: a.n_edges], n_model
+            a.h_src, a.h_offsets, a.host_dst(), n_model
         )
         self._sharded[key] = (a, sa)
         return sa

@@ -57,11 +57,22 @@ class PredicateData:
 class PostingStore:
     """The mutable graph: schema + uid dictionary + per-predicate postings."""
 
+    # per-predicate mutation journal cap: deltas beyond this fall back to
+    # a full arena rebuild (bulk loads overflow immediately, point
+    # mutations stay incremental — the gentle-commit amortization analog,
+    # posting/lists.go:109-215)
+    DELTA_MAX = 65536
+
     def __init__(self, schema: Optional[SchemaState] = None):
         self.schema = schema if schema is not None else SchemaState()
         self.uids = UidMap()
         self._preds: Dict[str, PredicateData] = {}
         self.dirty: Set[str] = set()
+        # pred -> [(src, dst, +1|-1), ...] since the last arena refresh;
+        # None = overflowed (full rebuild required).  Only uid-edge ops
+        # journal here; value mutations always force a full refresh of
+        # the value/index arenas (cheap: those arenas are value-sized).
+        self.delta: Dict[str, Optional[List[Tuple[int, int, int]]]] = {}
         # runtime cluster membership (MEMBER records) — only meaningful
         # on the metadata group's replica store; member_hook fires on
         # apply so the cluster service can rewire transports live
@@ -115,6 +126,19 @@ class PostingStore:
 
     # -- mutation ----------------------------------------------------------
 
+    def _journal_delta(self, pred: str, src: int, dst: int, sign: int) -> None:
+        d = self.delta.get(pred, [])
+        if d is None:
+            return  # already overflowed
+        if len(d) >= self.DELTA_MAX:
+            self.delta[pred] = None
+            return
+        d.append((src, dst, sign))
+        self.delta[pred] = d
+
+    def _delta_overflow(self, pred: str) -> None:
+        self.delta[pred] = None
+
     def apply(self, e: Edge) -> None:
         """Apply one edge mutation (AddMutationWithIndex analog,
         posting/index.go:273 — index derivation happens at arena build)."""
@@ -123,6 +147,7 @@ class PostingStore:
         if e.op == "set":
             if e.value is not None:
                 p.values[(e.src, e.lang)] = e.value
+                self._delta_overflow(e.pred)  # value/index arenas rebuild
                 if e.lang:
                     # invalidate the lazy lang-presence flag (functions.py
                     # caches it on this live object)
@@ -133,13 +158,22 @@ class PostingStore:
                 if e.facets:
                     p.value_facets[e.src] = dict(e.facets)
             else:
-                p.edges.setdefault(e.src, set()).add(e.dst)
+                tgt = p.edges.setdefault(e.src, set())
+                if e.dst not in tgt:
+                    tgt.add(e.dst)
+                    self._journal_delta(e.pred, e.src, e.dst, +1)
+                else:
+                    # facet-only / no-op touch: arenas unaffected — keep
+                    # an (empty) journal entry so refresh skips the
+                    # rebuild (setdefault preserves an overflow None)
+                    self.delta.setdefault(e.pred, [])
                 if e.facets:
                     p.edge_facets[(e.src, e.dst)] = dict(e.facets)
         elif e.op == "del":
             if e.value is not None or e.dst == 0:
                 p.values.pop((e.src, e.lang), None)
                 p.value_facets.pop(e.src, None)
+                self._delta_overflow(e.pred)
                 if e.lang:
                     try:
                         del p._has_langs
@@ -147,10 +181,13 @@ class PostingStore:
                         pass
             else:
                 s = p.edges.get(e.src)
-                if s is not None:
+                if s is not None and e.dst in s:
                     s.discard(e.dst)
                     if not s:
                         del p.edges[e.src]
+                    self._journal_delta(e.pred, e.src, e.dst, -1)
+                else:
+                    self.delta.setdefault(e.pred, [])  # no-op delete
                 p.edge_facets.pop((e.src, e.dst), None)
         else:
             raise ValueError(f"unknown mutation op {e.op!r}")
@@ -175,6 +212,7 @@ class PostingStore:
             return
         p = self.pred(pred)
         self.dirty.add(pred)
+        self._delta_overflow(pred)  # bulk volume: full rebuild is cheaper
         order = np.argsort(src, kind="stable")
         s = src[order]
         d = dst[order]
@@ -200,6 +238,7 @@ class PostingStore:
         """posting.DeletePredicate analog (posting/index.go:666)."""
         self._preds.pop(pred, None)
         self.dirty.add(pred)
+        self._delta_overflow(pred)
 
     def set_edge(self, pred: str, src: int, dst: int, facets=None):
         self.apply(Edge(pred=pred, src=src, dst=dst, facets=facets))

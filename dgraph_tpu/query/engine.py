@@ -475,6 +475,7 @@ class QueryEngine:
             out, seg_ptr = arena.expand_host(rows)
             self.stats["edges"] += len(out)
             return out, seg_ptr
+        arena.ensure_device()  # re-upload after incremental host deltas
         packed = np.asarray(  # one fetch: out|seg concatenated on device
             _packed_expand_csr(
                 arena.offsets, arena.dst, ops.pad_rows(rows, ops.bucket(n)), cap

@@ -89,6 +89,13 @@ class FuncResolver:
             return self._bound(self._terms(fn, "fulltext", name == "alloftext"), candidates)
         if name == "has":
             a = self.arenas.has_rows(fn.attr)
+            pd = self.store.peek(fn.attr)
+            if pd is None or not pd.values:
+                # plain data arena: incremental deltas leave degree-0
+                # rows behind after deletes — has() must not report them
+                n = len(a.h_src)
+                deg = a.h_offsets[1 : n + 1] - a.h_offsets[:n]
+                return self._bound(a.h_src[deg > 0].copy(), candidates)
             return self._bound(a.h_src.copy(), candidates)
         if name == "regexp":
             return self._bound(self._regexp(fn), candidates)
@@ -121,6 +128,8 @@ class FuncResolver:
             out, _seg = arena.expand_host(rows)
             return np.unique(out)
         cap = ops.bucket(total)
+        if hasattr(arena, "ensure_device"):
+            arena.ensure_device()  # stale after incremental host deltas
         out, _seg, _t = ops.expand_csr(
             arena.offsets, arena.dst, ops.pad_rows(rows, ops.bucket(len(rows))), cap
         )
@@ -395,6 +404,11 @@ class FuncResolver:
         arena = self.arenas.data(fn.attr)
         degs = arena.h_offsets[1:] - arena.h_offsets[:-1]
         src = arena.h_src
+        # incremental deletes leave degree-0 rows in patched arenas; a
+        # row-less uid and a zero-degree row must behave identically
+        # (count-0 matches only through the explicit candidates union)
+        live = degs > 0
+        src, degs = src[live], degs[live]
         op = fn.name
         mask = {
             "eq": degs == n,

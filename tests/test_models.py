@@ -167,11 +167,13 @@ def test_value_arena_and_dirty_refresh():
     va2 = am.values("age")
     i = np.searchsorted(va2.h_src, uids["carol"])
     assert va2.h_vals[i] == 36.0
-    # data arena also refreshed on edge mutation
+    # data arena also refreshed on edge mutation (incremental delta
+    # updates the cached arena IN PLACE; count captured before)
     a1 = am.data("friend")
+    n_before = a1.n_edges
     st.set_edge("friend", uids["dan"], uids["alice"])
     a2 = am.data("friend")
-    assert a2.n_edges == a1.n_edges + 1
+    assert a2.n_edges == n_before + 1
 
 
 def test_tokenizers():
