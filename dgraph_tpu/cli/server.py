@@ -65,6 +65,12 @@ def build_options(argv=None) -> Options:
     p.add_argument("--num_pending", type=int, default=d.num_pending)
     p.add_argument("--max_edges", type=int, default=d.max_edges)
     p.add_argument("--config", default="", help="YAML config file (flat key: value)")
+    p.add_argument("--cpu", dest="cpu_profile", default=d.cpu_profile,
+                   help="write a CPU profile (pstats format) here on "
+                        "shutdown (main.go:181 --cpu analog)")
+    p.add_argument("--mem", dest="mem_profile", default=d.mem_profile,
+                   help="write a memory allocation profile (tracemalloc "
+                        "top-50 text) here on shutdown")
     ns = p.parse_args(argv)
     # start from the YAML-merged defaults so Options fields without a flag
     # survive (previously YAML-only keys like workers were dropped)
@@ -74,6 +80,17 @@ def build_options(argv=None) -> Options:
 
 def main(argv=None) -> int:
     opts = build_options(argv)
+    # profiling surface (setupProfiling, cmd/dgraph/main.go:181): start
+    # collectors before any serving work so boot cost is captured too
+    profiler = None
+    if opts.cpu_profile:
+        import cProfile
+
+        profiler = cProfile.Profile()
+    if opts.mem_profile:
+        import tracemalloc
+
+        tracemalloc.start(10)
     cluster = None
     if opts.join and not opts.peer:
         # runtime join: boot passive with only ourselves, then announce
@@ -144,6 +161,7 @@ def main(argv=None) -> int:
         tls_key=opts.tls_key,
         cluster=cluster,
     )
+    srv._profiler = profiler  # per-request profiling under the engine lock
     srv.start()
     print(f"dgraph-tpu serving at {srv.addr}  (dashboard at /, queries at /query)")
 
@@ -159,6 +177,19 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGINT, on_signal)
     signal.signal(signal.SIGTERM, on_signal)
+    def dump_profiles():
+        if profiler is not None:
+            profiler.dump_stats(opts.cpu_profile)
+            print(f"cpu profile written to {opts.cpu_profile}")
+        if opts.mem_profile:
+            import tracemalloc
+
+            snap = tracemalloc.take_snapshot()
+            with open(opts.mem_profile, "w") as f:
+                for stat in snap.statistics("lineno")[:50]:
+                    f.write(str(stat) + "\n")
+            print(f"memory profile written to {opts.mem_profile}")
+
     try:
         while srv._thread is not None and srv._thread.is_alive():
             srv._thread.join(timeout=0.5)
@@ -168,6 +199,7 @@ def main(argv=None) -> int:
     # blocks until the store is durably closed even when shutdown was
     # initiated by /admin/shutdown on a daemon thread
     srv.stop()
+    dump_profiles()
     return 0
 
 

@@ -13,6 +13,7 @@ reference draws at the ServeTask boundary (SURVEY.md §2c).
 from __future__ import annotations
 
 import os
+import time as _time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -49,6 +50,21 @@ def _make_packed_expand():
 _packed_expand_csr = _make_packed_expand()
 
 
+def _fresh_stats() -> dict:
+    """Per-request engine stats: edges traversed + per-stage wall time
+    (ms) — the per-query device/host breakdown the reference exposes
+    through --trace + pprof (cmd/dgraph/main.go:181); surfaced in the
+    latency map when the request carries debug=true."""
+    return {
+        "edges": 0,
+        "chain_fused_levels": 0,
+        "host_expand_ms": 0.0,
+        "device_expand_ms": 0.0,
+        "chain_ms": 0.0,
+        "device_order_ms": 0.0,
+    }
+
+
 class QueryEngine:
     """One engine instance per store; thread-unsafe by design (the serving
     layer serializes, as the reference does per-request goroutines over
@@ -69,8 +85,9 @@ class QueryEngine:
         # the reference's intersection-algorithm choice (uidlist.go:56-64).
         # Stored on the ArenaManager so FuncResolver shares the policy.
         # per-request execution stats (reset by run_parsed): edge traversal
-        # counts feed bench_engine and the /debug latency map
-        self.stats = {"edges": 0, "chain_fused_levels": 0}
+        # counts + per-stage timings feed bench_engine and the debug
+        # latency map
+        self.stats = _fresh_stats()
 
     @property
     def expand_device_min(self) -> int:
@@ -90,7 +107,7 @@ class QueryEngine:
     def run_parsed(self, parsed: "gql.ParsedResult") -> dict:
         """Execute an already-parsed request — the single request pipeline
         shared by the embedded path (run) and the HTTP server."""
-        self.stats = {"edges": 0, "chain_fused_levels": 0}
+        self.stats = _fresh_stats()
         out: dict = {}
         if parsed.mutation is not None:
             from dgraph_tpu.serve.mutations import (
@@ -393,7 +410,11 @@ class QueryEngine:
         if child.chain_stash is None:
             from dgraph_tpu.query.chain import try_run_chain
 
+            t0 = _time.perf_counter()
             try_run_chain(self, child, src)
+            # failed attempts count too: planning cost must show up in
+            # SOME bucket or the breakdown misleads
+            self.stats["chain_ms"] += (_time.perf_counter() - t0) * 1e3
         if child.chain_stash is not None and child.chain_stash[0] == "light":
             _tag, dest, stash_src, n_edges = child.chain_stash
             child.chain_stash = None
@@ -472,15 +493,19 @@ class QueryEngine:
             # a device dispatch costs a transport round trip that dwarfs
             # the work (the size-adaptive routing the reference does
             # per-intersection, algo/uidlist.go:56-64, done per-level)
+            t0 = _time.perf_counter()
             out, seg_ptr = arena.expand_host(rows)
             self.stats["edges"] += len(out)
+            self.stats["host_expand_ms"] += (_time.perf_counter() - t0) * 1e3
             return out, seg_ptr
+        t0 = _time.perf_counter()
         arena.ensure_device()  # re-upload after incremental host deltas
         packed = np.asarray(  # one fetch: out|seg concatenated on device
             _packed_expand_csr(
                 arena.offsets, arena.dst, ops.pad_rows(rows, ops.bucket(n)), cap
             )
         )
+        self.stats["device_expand_ms"] += (_time.perf_counter() - t0) * 1e3
         out = packed[:total].astype(np.int64)
         seg = packed[cap : cap + total].astype(np.int64)
         counts = np.bincount(seg, minlength=n)
@@ -649,6 +674,7 @@ class QueryEngine:
             return np.lexsort((key, owner)).astype(np.int64)
         import jax.numpy as jnp
 
+        t0 = _time.perf_counter()
         cap = ops.bucket(n)
         uids_pad = jnp.asarray(ops.pad_to(out, cap))
         seg_pad = np.full(cap, -1, dtype=np.int32)
@@ -657,6 +683,7 @@ class QueryEngine:
         perm = np.asarray(
             ops.segmented_sort_perm(jnp.asarray(seg_pad), ranks, bool(desc))
         )
+        self.stats["device_order_ms"] += (_time.perf_counter() - t0) * 1e3
         return perm[:n].astype(np.int64)  # padding sorts to the tail
 
     def _host_order_perm(

@@ -76,6 +76,10 @@ class DgraphServer:
         self._port = port
         self._tls_cert = tls_cert
         self._tls_key = tls_key
+        # shared cProfile enabled per-request under the engine lock when
+        # the CLI passes --cpu (profiling must cover handler threads,
+        # where all query execution happens — not just the main thread)
+        self._profiler = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -156,7 +160,7 @@ class DgraphServer:
 
             debug_token = outputnode.DEBUG_UIDS.set(debug)
             try:
-                self._run_locked(parsed, out)
+                stats = self._run_locked(parsed, out)
             finally:
                 outputnode.DEBUG_UIDS.reset(debug_token)
             lat.record_processing()
@@ -165,14 +169,34 @@ class DgraphServer:
             # latency map is complete before attaching it
             lat.record_json()
             out["server_latency"] = lat.to_map()
+            if debug:
+                # per-stage engine breakdown (device vs host vs fused
+                # chain time + edges traversed) — the per-query profile
+                # surface (reference: --trace + pprof, main.go:181).
+                # ``stats`` was snapshotted under the engine lock: a
+                # concurrent request resets engine.stats.
+                out["server_latency"]["engine"] = {
+                    k: (round(v, 3) if isinstance(v, float) else v)
+                    for k, v in stats.items()
+                }
             return out
         finally:
             PENDING_QUERIES.add(-1)
             self.tracer.finish(tr, "query", text[:120])
 
-    def _run_locked(self, parsed, out: dict) -> None:
+    def _run_locked(self, parsed, out: dict) -> dict:
         with self._engine_lock:
-            out.update(self.engine.run_parsed(parsed))
+            if self._profiler is not None:
+                # the engine lock guarantees exclusive use of the shared
+                # profiler (cProfile is not thread-safe, and handler
+                # threads are where all query work happens)
+                self._profiler.enable()
+            try:
+                out.update(self.engine.run_parsed(parsed))
+            finally:
+                if self._profiler is not None:
+                    self._profiler.disable()
+            return dict(self.engine.stats)
 
 
 def _auto_mesh():

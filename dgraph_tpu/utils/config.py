@@ -44,6 +44,11 @@ class Options:
     # observability
     trace_ratio: float = 0.0
     expose_trace: bool = False
+    # profiling (cmd/dgraph/main.go:181 --cpu/--mem analog): output paths,
+    # written at shutdown; empty = disabled
+    cpu_profile: str = ""
+    mem_profile: str = ""
+
     # engine
     num_pending: int = 1000
     max_edges: int = 1_000_000
