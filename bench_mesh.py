@@ -1,0 +1,101 @@
+"""Sharded-vs-local expansion throughput on the virtual 8-device mesh
+(VERDICT r3 item 4: a recorded ratio at a 21M-scale predicate).
+
+Runs on the CPU backend with xla_force_host_platform_device_count=8 —
+the same harness the driver's dryrun uses — so the ratio measures the
+SPMD program structure (shard_map + all_gather + device reassembly), not
+chip count: 8 virtual devices share one host's cores, so the expected
+win is bounded by core utilization, and the interesting numbers are
+(a) sharded ≈ local (no pathological collective overhead) and (b) the
+per-level host reassembly of round 2 is gone (one packed transfer).
+
+Usage: python bench_mesh.py   (env: BM_EDGES, default 21_000_000)
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import json
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from dgraph_tpu import ops
+from dgraph_tpu.models.arena import csr_dense_from_edges
+from dgraph_tpu.parallel.mesh import (
+    make_mesh,
+    shard_arena_rows,
+    sharded_expand_segments,
+)
+
+
+def main():
+    n_edges = int(os.environ.get("BM_EDGES", 21_000_000))
+    n_nodes = max(1024, n_edges // 10)
+    rng = np.random.default_rng(5)
+    src = rng.integers(1, n_nodes + 1, size=n_edges)
+    dst = rng.integers(1, n_nodes + 1, size=n_edges)
+    t0 = time.time()
+    a = csr_dense_from_edges(src, dst, n_nodes)
+    build_s = time.time() - t0
+
+    mesh = make_mesh(8, data=1)
+    t0 = time.time()
+    sa = shard_arena_rows(a.h_src, a.h_offsets, a.host_dst(), 8)
+    shard_s = time.time() - t0
+
+    frontiers = [
+        np.unique(rng.integers(1, n_nodes + 1, size=4096)) for _ in range(10)
+    ]
+    cap = ops.bucket(
+        max(
+            int(a.degree_of_rows(a.rows_for_uids_host(f)).sum())
+            for f in frontiers
+        )
+    )
+
+    # warm both paths (compile)
+    sharded_expand_segments(mesh, sa, frontiers[0], cap)
+    rows0 = ops.pad_rows(a.rows_for_uids_host(frontiers[0]), ops.bucket(len(frontiers[0])))
+    out, seg, _ = ops.expand_csr(a.offsets, a.dst, rows0, cap)
+    np.asarray(out)
+
+    t0 = time.time()
+    edges = 0
+    for f in frontiers:
+        o, ptr = sharded_expand_segments(mesh, sa, f, cap)
+        edges += len(o)
+    sharded_s = time.time() - t0
+
+    t0 = time.time()
+    edges_l = 0
+    for f in frontiers:
+        rows = ops.pad_rows(a.rows_for_uids_host(f), ops.bucket(len(f)))
+        out, seg, _t = ops.expand_csr(a.offsets, a.dst, rows, cap)
+        seg_h = np.asarray(seg)
+        edges_l += int((seg_h >= 0).sum())
+    local_s = time.time() - t0
+
+    assert edges == edges_l, (edges, edges_l)
+    print(json.dumps({
+        "metric": "mesh_sharded_vs_local_expand",
+        "edges_per_query": edges // len(frontiers),
+        "sharded_ms": round(sharded_s / len(frontiers) * 1e3, 1),
+        "local_ms": round(local_s / len(frontiers) * 1e3, 1),
+        "ratio_local_over_sharded": round(local_s / sharded_s, 2),
+        "n_devices": 8,
+        "platform": "cpu-virtual-mesh",
+        "build_s": round(build_s, 1),
+        "shard_s": round(shard_s, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

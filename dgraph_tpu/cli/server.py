@@ -80,8 +80,10 @@ def build_options(argv=None) -> Options:
 
 def main(argv=None) -> int:
     opts = build_options(argv)
-    # profiling surface (setupProfiling, cmd/dgraph/main.go:181): start
-    # collectors before any serving work so boot cost is captured too
+    # profiling surface (setupProfiling, cmd/dgraph/main.go:181).  The
+    # CPU profile covers QUERY EXECUTION (enabled per-request under the
+    # engine lock — cProfile is per-thread, and a main-thread profiler
+    # would only see the idle join loop); tracemalloc covers boot too.
     profiler = None
     if opts.cpu_profile:
         import cProfile
@@ -160,8 +162,8 @@ def main(argv=None) -> int:
         tls_cert=opts.tls_cert,
         tls_key=opts.tls_key,
         cluster=cluster,
+        profiler=profiler,
     )
-    srv._profiler = profiler  # per-request profiling under the engine lock
     srv.start()
     print(f"dgraph-tpu serving at {srv.addr}  (dashboard at /, queries at /query)")
 

@@ -9,7 +9,7 @@ under shard_map with XLA collectives over ICI instead of gRPC calls.
 
 from dgraph_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
-    seg_expand_step,
+    seg_expand_packed_step,
     shard_arena_rows,
     sharded_expand_segments,
     sharded_expand_step,

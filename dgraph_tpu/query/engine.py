@@ -487,7 +487,13 @@ class QueryEngine:
             from dgraph_tpu.parallel.mesh import sharded_expand_segments
 
             sharded = self.arenas.sharded_csr(attr, reverse=reverse)
-            return sharded_expand_segments(self.arenas.mesh, sharded, src, cap)
+            t0 = _time.perf_counter()
+            out, seg_ptr = sharded_expand_segments(
+                self.arenas.mesh, sharded, src, cap
+            )
+            self.stats["edges"] += len(out)
+            self.stats["device_expand_ms"] += (_time.perf_counter() - t0) * 1e3
+            return out, seg_ptr
         if total < self.expand_device_min:
             # small expansion: vectorized numpy over the host CSR mirror —
             # a device dispatch costs a transport round trip that dwarfs

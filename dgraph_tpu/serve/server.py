@@ -54,6 +54,7 @@ class DgraphServer:
         tls_cert: str = "",
         tls_key: str = "",
         cluster=None,
+        profiler=None,
     ):
         self.cluster = cluster  # ClusterService when clustered, else None
         self.store = store
@@ -79,7 +80,7 @@ class DgraphServer:
         # shared cProfile enabled per-request under the engine lock when
         # the CLI passes --cpu (profiling must cover handler threads,
         # where all query execution happens — not just the main thread)
-        self._profiler = None
+        self._profiler = profiler
 
     # -- lifecycle ---------------------------------------------------------
 
