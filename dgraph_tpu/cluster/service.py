@@ -593,9 +593,11 @@ class ClusterStore:
         self._remote: Dict[str, list] = {}
         self._predlists: Dict[int, list] = {}
         self._remote_lock = threading.Lock()  # guards the cache DICTS only
-        # per-predicate fetch locks: one unreachable owner must stall only
-        # its own predicate, not the whole cross-server read plane
-        self._fetch_locks: Dict[str, threading.Lock] = {}
+        # per-key fetch locks: one unreachable owner must stall only its
+        # own key, not the whole cross-server read plane.  Keys are either
+        # a predicate name (_remote_peek) or ("__predlist__", gid)
+        # (predicates) — tuples can never collide with predicate strings.
+        self._fetch_locks: Dict[object, threading.Lock] = {}
         self.remote_ttl = remote_ttl
 
     @property
@@ -751,16 +753,31 @@ class ClusterStore:
         for gid in self._svc.conf.known_groups():
             if gid in self._svc.groups:
                 continue
+            # same lock rule as _remote_peek: _remote_lock guards the cache
+            # dicts only — the network fetch happens outside it, serialized
+            # per-gid by a fetch lock so one unreachable group (5s timeout)
+            # never stalls _remote_peek readers or other groups' fetches
             with self._remote_lock:
                 now = _time.monotonic()
                 ent = self._predlists.get(gid)
-                if ent is None or now - ent[1] >= self.remote_ttl:
-                    names = self._svc.fetch_predlist(gid)
+                if ent is not None and now - ent[1] < self.remote_ttl:
+                    out.update(ent[0])
+                    continue
+                flock = self._fetch_locks.setdefault(
+                    ("__predlist__", gid), threading.Lock()
+                )
+            with flock:
+                with self._remote_lock:
+                    ent = self._predlists.get(gid)
+                    if ent is not None and _time.monotonic() - ent[1] < self.remote_ttl:
+                        out.update(ent[0])
+                        continue
+                names = self._svc.fetch_predlist(gid)
+                with self._remote_lock:
                     if names is None:  # owner unreachable: keep stale list
                         names = ent[0] if ent is not None else []
-                    self._predlists[gid] = [names, now]
-                    ent = self._predlists[gid]
-                out.update(ent[0])
+                    self._predlists[gid] = [names, _time.monotonic()]
+                    out.update(names)
         return sorted(out)
 
     def value(self, pred: str, uid: int, lang: str = ""):

@@ -289,9 +289,13 @@ class _Parser:
                             v += "@" + self.expect("name").text
                     elif key in ("first", "offset", "after", "depth", "numpaths"):
                         # integer args validate at parse time (parser.go:360
-                        # "Expected an int but got %v")
+                        # "Expected an int but got %v"); counts are base 10
+                        # to match the reference's strconv semantics
+                        # (leading-zero literals parse as decimal, 0x is
+                        # rejected) — but `after` is a uid boundary and
+                        # keeps accepting hex like uid() does
                         try:
-                            int(v, 0)
+                            int(v, 0 if key == "after" else 10)
                         except ValueError:
                             raise ParseError(
                                 f"expected an int for {key}: but got {v!r}"

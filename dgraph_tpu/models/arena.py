@@ -125,7 +125,12 @@ class CSRArena:
                 self.h_offsets[:-1], deg
             )
             chunk[coff[rowid] + within // C, within % C] = h_dst
-        Sb = self.offsets.shape[0] - 1
+        # size from HOST state, not the device offsets tensor: after
+        # apply_delta the device tensors are stale until ensure_device(),
+        # but chunked() must serve fused chains immediately (a new source
+        # row crossing the power-of-two row bucket would otherwise break
+        # the meta[:S] broadcast below)
+        Sb = ops.bucket(max(1, self.n_rows))
         meta = np.zeros((Sb, 8), dtype=np.int32)
         meta[:S, 0] = coff[:-1]
         meta[:S, 1] = cdeg

@@ -443,3 +443,12 @@ def test_regexp_and_terms():
     assert res.queries[0].func.name == "regexp"
     f = res.queries[0].children[0].filter.func
     assert f.name == "anyofterms" and f.args == ["alice bob"]
+
+
+def test_pagination_int_args_base10():
+    """ADVICE r3 (low): integer args parse in base 10 like the reference —
+    leading-zero literals are decimal, hex is rejected."""
+    res = parse("{ me(func: uid(1), first: 010) { name } }")
+    assert res.queries[0].args["first"] == "010"  # decodes as 10 downstream
+    with pytest.raises(ParseError):
+        parse("{ me(func: uid(1), first: 0x10) { name } }")

@@ -103,3 +103,30 @@ def test_has_excludes_emptied_rows():
     eng.run("mutation { delete { <0x3> <knows> <0x4> . } }")
     got = eng.run("{ q(func: has(knows)) { _uid_ } }")
     assert [x["_uid_"] for x in got["q"]] == ["0x1"]
+
+
+def test_chunked_after_row_bucket_growth():
+    """ADVICE r3 (high): chunked() must size its meta from HOST state.
+    After apply_delta adds a new source row that crosses the power-of-two
+    row bucket, a fused chain calls a.chunked() without ensure_device() —
+    this used to crash broadcasting meta[:S] into a stale-bucket array."""
+    st = PostingStore()
+    am = ArenaManager(st)
+    # exactly 8 rows -> row bucket 8
+    st.bulk_set_uid_edges("e", np.arange(1, 9), np.arange(11, 19))
+    a = am.data("e")
+    assert a.n_rows == 8
+    a.chunked()  # build once at the old bucket
+    st.set_edge("e", 9, 19)  # 9th source row crosses the bucket
+    a = am.data("e")
+    assert a.n_rows == 9
+    meta8, chunk_dst = a.chunked()  # must not raise
+    assert meta8.shape[0] >= 9
+    # row 8 (uid 9) must be queryable through the chunked layout
+    import numpy as _np
+
+    m = _np.asarray(meta8)
+    row = int(_np.searchsorted(a.h_src, 9))
+    cs, cd, deg = m[row, 0], m[row, 1], m[row, 2]
+    assert (cd, deg) == (1, 1)
+    assert int(_np.asarray(chunk_dst)[cs, 0]) == 19

@@ -205,3 +205,46 @@ def test_pred_versions_are_per_predicate(placed):
     # a write to city itself DOES bump it
     _post(placed[1].addr, "/query", 'mutation { set { <0x12> <city> "bern" . } }')
     assert _wait(lambda: fetch_city_ver(ver)[0] == 200)
+
+
+def test_predicates_fetch_does_not_hold_remote_lock():
+    """ADVICE r3 (medium): ClusterStore.predicates() must not hold
+    _remote_lock across the (possibly 5s-timeout) fetch_predlist network
+    call — one unreachable group would stall every _remote_peek reader."""
+    import threading
+    import time as _t
+
+    from dgraph_tpu.cluster.service import ClusterStore
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    class _Conf:
+        def known_groups(self):
+            return [1, 7]  # 7 is not placed locally -> predlist fetch
+
+    class _Svc:
+        groups = {}
+        conf = _Conf()
+        peer_groups = {1: [], 7: []}
+
+        def fetch_predlist(self, gid, timeout=5.0):
+            entered.set()
+            assert release.wait(5), "test deadlock"
+            return ["remote_pred"]
+
+        def servers_of_group(self, gid):
+            return ["somewhere"]
+
+    store = ClusterStore(_Svc())
+    t = threading.Thread(target=store.predicates, daemon=True)
+    t.start()
+    assert entered.wait(5)
+    # while the fetch is stalled, the cache lock must be free
+    got_lock = store._remote_lock.acquire(timeout=1.0)
+    assert got_lock, "_remote_lock held across the network fetch"
+    store._remote_lock.release()
+    release.set()
+    t.join(5)
+    assert not t.is_alive()
+    assert "remote_pred" in store.predicates()
