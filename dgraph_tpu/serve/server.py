@@ -438,7 +438,18 @@ def _make_handler(srv: DgraphServer):
                     vars_hdr = self.headers.get("X-Dgraph-Vars")
                     variables = json.loads(vars_hdr) if vars_hdr else None
                     out = srv.run_query(body, variables, debug=debug)
-                    self._reply(200, json.dumps(out).encode())
+                    accept = self.headers.get("Accept", "")
+                    if "application/protobuf" in accept or "application/x-protobuf" in accept:
+                        # binary client surface: protobuf wire-format
+                        # Response (graphresponse.proto), hand-encoded —
+                        # see serve/proto.py
+                        from dgraph_tpu.serve import proto as _proto
+
+                        self._reply(
+                            200, _proto.encode_response(out), "application/protobuf"
+                        )
+                    else:
+                        self._reply(200, json.dumps(out).encode())
                 except Exception as e:
                     self._err(400, str(e))
             elif u.path == "/share":
