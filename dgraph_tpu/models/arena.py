@@ -23,6 +23,7 @@ the gentle-commit + lcache refresh cycle, posting/lists.go:109-215).
 from __future__ import annotations
 
 import bisect
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -34,6 +35,14 @@ from dgraph_tpu.ops.sets import SENT
 from dgraph_tpu import tok as tokmod
 from dgraph_tpu.models.store import PostingStore
 from dgraph_tpu.models.types import TypeID, TypedValue, numeric
+
+
+# Shared lock for lazy per-arena derived-structure builds (ensure_device,
+# chunked, lut).  Struck once per build, never on warm reads — the warm
+# paths double-check their cached field before locking.  A single module
+# lock (vs per-arena) keeps CSRArena a plain dataclass; contention is
+# limited to cold-cache bursts.
+_BUILD_LOCK = threading.RLock()
 
 
 @dataclass
@@ -108,6 +117,12 @@ class CSRArena:
         """
         if self._chunked is not None:
             return self._chunked
+        with _BUILD_LOCK:
+            return self._chunked_locked()
+
+    def _chunked_locked(self) -> tuple:
+        if self._chunked is not None:  # lost the build race: reuse
+            return self._chunked
         C = ops.CHUNK
         S = self.n_rows
         E = self.n_edges
@@ -153,12 +168,16 @@ class CSRArena:
         need = ops.bucket(max(1, universe + 1))
         if self._lut is not None and self._lut.shape[0] >= need:
             return self._lut
-        t = np.full(need, -1, dtype=np.int32)
-        if self.n_rows:
-            keys = self.h_src[self.h_src <= universe]
-            t[keys] = np.arange(len(keys), dtype=np.int32)
-        self._lut = jnp.asarray(t)
-        return self._lut
+        with _BUILD_LOCK:
+            cur = self._lut
+            if cur is not None and cur.shape[0] >= need:
+                return cur
+            t = np.full(need, -1, dtype=np.int32)
+            if self.n_rows:
+                keys = self.h_src[self.h_src <= universe]
+                t[keys] = np.arange(len(keys), dtype=np.int32)
+            self._lut = jnp.asarray(t)
+            return self._lut
 
     def rows_for_uids_host(self, uids: np.ndarray) -> np.ndarray:
         pos = np.searchsorted(self.h_src, uids)
@@ -231,14 +250,23 @@ class CSRArena:
 
     def ensure_device(self) -> None:
         """Re-upload device tensors from the host mirrors if a delta made
-        them stale (one upload amortizes a burst of point mutations)."""
+        them stale (one upload amortizes a burst of point mutations).
+
+        Thread-safe under concurrent readers: the rebuild updates several
+        fields, so it runs under the shared build lock with a re-check;
+        the staleness flag clears LAST, so lock-free fast-path readers
+        only skip once every field is fresh (mutations themselves are
+        excluded by the server's write lock — see utils/rwlock.py)."""
         if not self._device_stale:
             return
-        fresh = _csr_from_arrays(self.h_src, self.h_offsets, self._h_dst)
-        self.src = fresh.src
-        self.offsets = fresh.offsets
-        self.dst = fresh.dst
-        self._device_stale = False
+        with _BUILD_LOCK:
+            if not self._device_stale:
+                return
+            fresh = _csr_from_arrays(self.h_src, self.h_offsets, self._h_dst)
+            self.src = fresh.src
+            self.offsets = fresh.offsets
+            self.dst = fresh.dst
+            self._device_stale = False
 
 
 def _build_csr(rows_to_dsts: Dict[int, np.ndarray]) -> CSRArena:
@@ -393,11 +421,27 @@ class ValueArena:
                                     # this arena agree uid-for-uid
 
 
+def _cache_locked(fn):
+    """Run an ArenaManager accessor under its cache lock (see __init__)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *a, **k):
+        with self._cache_lock:
+            return fn(self, *a, **k)
+
+    return wrapper
+
+
 class ArenaManager:
     """Builds and caches arenas; invalidates on store dirty marks.
 
     The analog of posting's lcache + periodicCommit (posting/lists.go):
     arenas for clean predicates stay resident on device between queries.
+    Accessors are thread-safe for concurrent read queries: the cache lock
+    guards dict lookups and dirty-refresh only; heavy builds run outside
+    it under per-key build locks (_get_or_build), so a cold predicate
+    stalls only readers of that same predicate.
     """
 
     def __init__(self, store: PostingStore, mesh=None, shard_threshold: int = 4096):
@@ -420,7 +464,36 @@ class ArenaManager:
         self._index: Dict[Tuple[str, str], IndexArena] = {}
         self._values: Dict[str, ValueArena] = {}
         self._sharded: Dict[Tuple[str, bool], tuple] = {}
+        # protects the cache dicts + refresh bookkeeping ONLY — heavy
+        # arena builds run outside it under per-key build locks
+        # (_get_or_build), so one cold predicate never stalls readers of
+        # warm ones.  RLock because accessors nest (has_rows → data).
+        self._cache_lock = threading.RLock()
+        self._build_locks: Dict[tuple, threading.Lock] = {}
 
+    def _get_or_build(self, cache, key, build):
+        """cache[key], building OUTSIDE the cache lock under a per-key
+        build lock: concurrent readers of other keys proceed; concurrent
+        readers of the same key wait for one build instead of duplicating
+        it (the pattern of ClusterStore._remote_peek's fetch locks)."""
+        lkey = (id(cache), key)
+        with self._cache_lock:
+            a = cache.get(key)
+            if a is not None:
+                return a
+            bl = self._build_locks.setdefault(lkey, threading.Lock())
+        with bl:
+            with self._cache_lock:
+                a = cache.get(key)
+                if a is not None:
+                    return a
+            a = build()
+            with self._cache_lock:
+                cache[key] = a
+                self._build_locks.pop(lkey, None)
+            return a
+
+    @_cache_locked
     def refresh(self):
         """Drop or incrementally update cached arenas for predicates
         mutated since last refresh.  Small uid-edge deltas (the store's
@@ -431,15 +504,20 @@ class ArenaManager:
         dirty = self.store.dirty
         if not dirty:
             return
+        # Never blanket-clear the dirty set: concurrent readers (admitted
+        # by the server's RW lock) may add marks between our snapshot and
+        # the clear (ClusterStore._drain_dirty runs inside peek); only
+        # remove marks we actually processed, so a racing mark survives
+        # for the next refresh.
         if "*" in dirty:  # full-store replacement (snapshot restore)
             self._data.clear()
             self._reverse.clear()
             self._values.clear()
             self._index.clear()
             self._sharded.clear()
-            dirty.clear()
-            getattr(self.store, "delta", {}).clear()
-            return
+            dirty.discard("*")
+            # remaining per-predicate marks fall through to the loop:
+            # their caches are already gone, so it just consumes deltas
         deltas = getattr(self.store, "delta", {})
         for p in list(dirty):
             delta = deltas.pop(p, None)
@@ -454,8 +532,7 @@ class ArenaManager:
             self._sharded.pop((p, True), None)
             for key in [k for k in self._index if k[0] == p]:
                 self._index.pop(key, None)
-        dirty.clear()
-        deltas.clear()
+            dirty.discard(p)
 
     def _try_apply_delta(self, pred: str, delta: list) -> bool:
         """Incrementally update the cached data (and reverse) arena for
@@ -499,15 +576,23 @@ class ArenaManager:
 
         a = self.reverse(pred) if reverse else self.data(pred)
         key = (pred, reverse)
-        cached = self._sharded.get(key)
-        if cached is not None and cached[0] is a:
-            return cached[1]
-        n_model = self.mesh.shape["model"]
-        sa = shard_arena_rows(
-            a.h_src, a.h_offsets, a.host_dst(), n_model
-        )
-        self._sharded[key] = (a, sa)
-        return sa
+        lkey = ("sharded", key)
+        with self._cache_lock:
+            cached = self._sharded.get(key)
+            if cached is not None and cached[0] is a:
+                return cached[1]
+            bl = self._build_locks.setdefault(lkey, threading.Lock())
+        with bl:  # shard split outside the cache lock (heavy host work)
+            with self._cache_lock:
+                cached = self._sharded.get(key)
+                if cached is not None and cached[0] is a:
+                    return cached[1]
+            n_model = self.mesh.shape["model"]
+            sa = shard_arena_rows(a.h_src, a.h_offsets, a.host_dst(), n_model)
+            with self._cache_lock:
+                self._sharded[key] = (a, sa)
+                self._build_locks.pop(lkey, None)
+            return sa
 
     def use_mesh_for(self, arena: CSRArena) -> bool:
         return self.mesh is not None and arena.n_rows >= self.shard_threshold
@@ -516,15 +601,13 @@ class ArenaManager:
 
     def data(self, pred: str) -> CSRArena:
         self.refresh()
-        a = self._data.get(pred)
-        if a is None:
-            pd = self.store.peek(pred)
-            if pd is not None and pd.edges:
-                a = csr_from_edges(*_edges_columnar(pd.edges))
-            else:
-                a = _build_csr({})
-            self._data[pred] = a
-        return a
+        return self._get_or_build(self._data, pred, lambda: self._build_data(pred))
+
+    def _build_data(self, pred: str) -> CSRArena:
+        pd = self.store.peek(pred)
+        if pd is not None and pd.edges:
+            return csr_from_edges(*_edges_columnar(pd.edges))
+        return _build_csr({})
 
     def has_rows(self, pred: str) -> CSRArena:
         """Arena whose rows are every uid with *any* posting (edge or value)
@@ -535,41 +618,39 @@ class ArenaManager:
         pd = self.store.peek(pred)
         if pd is None or not pd.values:
             return self.data(pred)
-        key = pred + "\x00has"
-        a = self._data.get(key)
-        if a is None:
-            universe = np.fromiter(
-                pd.uids_with_data(), dtype=np.int64
-            )
-            src, dst = _edges_columnar(pd.edges)
-            a = csr_from_edges(src, dst, row_universe=universe)
-            self._data[key] = a
-        return a
+        return self._get_or_build(
+            self._data, pred + "\x00has", lambda: self._build_has(pred)
+        )
+
+    def _build_has(self, pred: str) -> CSRArena:
+        pd = self.store.peek(pred)
+        universe = np.fromiter(pd.uids_with_data(), dtype=np.int64)
+        src, dst = _edges_columnar(pd.edges)
+        return csr_from_edges(src, dst, row_universe=universe)
 
     def reverse(self, pred: str) -> CSRArena:
         self.refresh()
-        a = self._reverse.get(pred)
-        if a is None:
-            pd = self.store.peek(pred)
-            if pd is not None and pd.edges:
-                src, dst = _edges_columnar(pd.edges)
-                a = csr_from_edges(dst, src)  # inverted: one lexsort, no
-                # per-target python append loop (posting/index.go:152)
-            else:
-                a = _build_csr({})
-            self._reverse[pred] = a
-        return a
+        return self._get_or_build(
+            self._reverse, pred, lambda: self._build_reverse(pred)
+        )
+
+    def _build_reverse(self, pred: str) -> CSRArena:
+        pd = self.store.peek(pred)
+        if pd is not None and pd.edges:
+            src, dst = _edges_columnar(pd.edges)
+            return csr_from_edges(dst, src)  # inverted: one lexsort, no
+            # per-target python append loop (posting/index.go:152)
+        return _build_csr({})
 
     # -- secondary indexes ---------------------------------------------------
 
     def index(self, pred: str, tokenizer: str) -> IndexArena:
         self.refresh()
-        key = (pred, tokenizer)
-        a = self._index.get(key)
-        if a is None:
-            a = self._build_index(pred, tokenizer)
-            self._index[key] = a
-        return a
+        return self._get_or_build(
+            self._index,
+            (pred, tokenizer),
+            lambda: self._build_index(pred, tokenizer),
+        )
 
     def _build_index(self, pred: str, tokenizer: str) -> IndexArena:
         tk = tokmod.get_tokenizer(tokenizer)
@@ -605,45 +686,47 @@ class ArenaManager:
 
     def values(self, pred: str) -> ValueArena:
         self.refresh()
-        a = self._values.get(pred)
-        if a is None:
-            pd = self.store.peek(pred)
-            pairs: Dict[int, float] = {}
-            langless = True
-            if pd is not None:
-                # Deterministic lang choice: untagged value wins, else the
-                # lexicographically first language (stable across ingest
-                # order, unlike dict iteration).
-                for (uid, lang) in sorted(pd.values.keys(), key=lambda k: (k[0], k[1] != "", k[1])):
-                    if lang:
-                        langless = False
-                    if uid in pairs:
-                        continue
-                    x = numeric(pd.values[(uid, lang)])
-                    if x is not None:
-                        pairs[uid] = x
-            uids = np.array(sorted(pairs.keys()), dtype=np.int64)
-            vals = np.array([pairs[u] for u in uids], dtype=np.float64)
-            S = len(uids)
-            Sb = ops.bucket(max(1, S))
-            su = np.full(Sb, SENT, dtype=np.int32)
-            su[:S] = uids.astype(np.int32)
-            vv = np.full(Sb, np.nan, dtype=np.float32)
-            vv[:S] = vals.astype(np.float32)
-            # dense rank of the exact float64 value: device order-by sorts
-            # by rank, immune to float32 rounding collisions
-            rk = np.full(Sb, -1, dtype=np.int32)
-            if S:
-                rk[:S] = np.searchsorted(np.unique(vals), vals).astype(np.int32)
-            a = ValueArena(
-                src=jnp.asarray(su),
-                vals=jnp.asarray(vv),
-                ranks=jnp.asarray(rk),
-                h_src=uids,
-                h_vals=vals,
-                h_ranks=rk[:S].copy(),
-                n=S,
-                langless=langless,
-            )
-            self._values[pred] = a
+        return self._get_or_build(
+            self._values, pred, lambda: self._build_values(pred)
+        )
+
+    def _build_values(self, pred: str) -> ValueArena:
+        pd = self.store.peek(pred)
+        pairs: Dict[int, float] = {}
+        langless = True
+        if pd is not None:
+            # Deterministic lang choice: untagged value wins, else the
+            # lexicographically first language (stable across ingest
+            # order, unlike dict iteration).
+            for (uid, lang) in sorted(pd.values.keys(), key=lambda k: (k[0], k[1] != "", k[1])):
+                if lang:
+                    langless = False
+                if uid in pairs:
+                    continue
+                x = numeric(pd.values[(uid, lang)])
+                if x is not None:
+                    pairs[uid] = x
+        uids = np.array(sorted(pairs.keys()), dtype=np.int64)
+        vals = np.array([pairs[u] for u in uids], dtype=np.float64)
+        S = len(uids)
+        Sb = ops.bucket(max(1, S))
+        su = np.full(Sb, SENT, dtype=np.int32)
+        su[:S] = uids.astype(np.int32)
+        vv = np.full(Sb, np.nan, dtype=np.float32)
+        vv[:S] = vals.astype(np.float32)
+        # dense rank of the exact float64 value: device order-by sorts
+        # by rank, immune to float32 rounding collisions
+        rk = np.full(Sb, -1, dtype=np.int32)
+        if S:
+            rk[:S] = np.searchsorted(np.unique(vals), vals).astype(np.int32)
+        a = ValueArena(
+            src=jnp.asarray(su),
+            vals=jnp.asarray(vv),
+            ranks=jnp.asarray(rk),
+            h_src=uids,
+            h_vals=vals,
+            h_ranks=rk[:S].copy(),
+            n=S,
+            langless=langless,
+        )
         return a

@@ -70,9 +70,20 @@ class QueryEngine:
     layer serializes, as the reference does per-request goroutines over
     shared immutable posting state)."""
 
-    def __init__(self, store: PostingStore, mesh=None, shard_threshold: int = 4096):
+    def __init__(
+        self, store: PostingStore, mesh=None, shard_threshold: int = 4096, arenas=None
+    ):
         self.store = store
-        self.arenas = ArenaManager(store, mesh=mesh, shard_threshold=shard_threshold)
+        # ``arenas`` shares a warm ArenaManager between engine instances:
+        # the serving layer creates one cheap engine per request (its own
+        # stats/traversal state) over the process-wide arena cache, the
+        # way the reference runs per-request goroutines over the shared
+        # posting lcache (query/query.go:1684, posting/lists.go)
+        self.arenas = (
+            arenas
+            if arenas is not None
+            else ArenaManager(store, mesh=mesh, shard_threshold=shard_threshold)
+        )
         from dgraph_tpu.query.chain import CHAIN_THRESHOLD
 
         # minimum estimated fan-out before chains fuse into one device

@@ -25,6 +25,7 @@ from dgraph_tpu.models.store import PostingStore
 from dgraph_tpu.query.engine import QueryEngine
 from dgraph_tpu.serve.export import export as export_rdf
 from dgraph_tpu.utils import HealthGate, Latency
+from dgraph_tpu.utils.rwlock import RWLock
 from dgraph_tpu.utils.metrics import (
     NUM_QUERIES,
     PENDING_QUERIES,
@@ -63,8 +64,17 @@ class DgraphServer:
         self.tracer = Tracer(trace_ratio)
         self.export_path = export_path
         self.expose_trace = expose_trace
-        self._engine_lock = threading.Lock()
+        # RW lock: read-only queries run CONCURRENTLY over the shared
+        # immutable arenas; mutations/stop take the exclusive side (the
+        # reference's per-request goroutines + posting RWMutex, see
+        # utils/rwlock.py).  Kept under the old name so operators' mental
+        # model ("the engine lock") still holds for the write side.
+        self._engine_lock = RWLock()
         self._stop_lock = threading.Lock()
+        # exports write a minute-stamped file; two concurrent exports
+        # would interleave gzip streams into one path — serialize them
+        # (they still share the READ side of the engine lock with queries)
+        self._export_lock = threading.Lock()
         self._stopped = False
         # bounded LRU: shares are a convenience surface, not durable state
         from collections import OrderedDict
@@ -133,7 +143,7 @@ class DgraphServer:
                 self._httpd.shutdown()
                 self._httpd.server_close()
                 self._httpd = None
-            with self._engine_lock:
+            with self._engine_lock.write():
                 if self.cluster is not None:
                     self.cluster.stop()
                 if hasattr(self.store, "close"):
@@ -174,8 +184,8 @@ class DgraphServer:
                 # per-stage engine breakdown (device vs host vs fused
                 # chain time + edges traversed) — the per-query profile
                 # surface (reference: --trace + pprof, main.go:181).
-                # ``stats`` was snapshotted under the engine lock: a
-                # concurrent request resets engine.stats.
+                # ``stats`` comes from this request's own engine shell,
+                # so concurrent queries can't clobber it.
                 out["server_latency"]["engine"] = {
                     k: (round(v, 3) if isinstance(v, float) else v)
                     for k, v in stats.items()
@@ -186,18 +196,29 @@ class DgraphServer:
             self.tracer.finish(tr, "query", text[:120])
 
     def _run_locked(self, parsed, out: dict) -> dict:
-        with self._engine_lock:
+        # Mutations (and the profiler, which is not thread-safe) need the
+        # exclusive side; pure queries share the read side and execute
+        # concurrently, each on its own engine shell over the shared
+        # arena cache (query/query.go:1684-1714 runs per-request
+        # goroutines the same way).
+        is_write = parsed.mutation is not None or self._profiler is not None
+        lock = (
+            self._engine_lock.write() if is_write else self._engine_lock.read()
+        )
+        with lock:
             if self._profiler is not None:
-                # the engine lock guarantees exclusive use of the shared
-                # profiler (cProfile is not thread-safe, and handler
-                # threads are where all query work happens)
                 self._profiler.enable()
             try:
-                out.update(self.engine.run_parsed(parsed))
+                if is_write:
+                    eng = self.engine  # exclusive: run on the main engine
+                else:
+                    eng = QueryEngine(self.store, arenas=self.engine.arenas)
+                    eng.chain_threshold = self.engine.chain_threshold
+                out.update(eng.run_parsed(parsed))
             finally:
                 if self._profiler is not None:
                     self._profiler.disable()
-            return dict(self.engine.stats)
+            return dict(eng.stats)
 
 
 def _auto_mesh():
@@ -270,7 +291,7 @@ def _make_handler(srv: DgraphServer):
 
                 self._reply(200, DASHBOARD_HTML.encode(), "text/html")
             elif path == "/debug/store":
-                with srv._engine_lock:
+                with srv._engine_lock.read():
                     stats = _store_stats(srv.store)
                 self._reply(200, json.dumps(stats).encode())
             elif path == "/debug/prometheus_metrics":
@@ -281,7 +302,7 @@ def _make_handler(srv: DgraphServer):
                 self._reply(200, json.dumps(srv.tracer.recent()).encode())
             elif path == "/admin/export":
                 try:
-                    with srv._engine_lock:
+                    with srv._export_lock, srv._engine_lock.read():
                         info = export_rdf(srv.store, srv.export_path)
                     self._reply(200, json.dumps(
                         {"code": "Success", "message": "Export completed.", **info}

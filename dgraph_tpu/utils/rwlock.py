@@ -1,0 +1,67 @@
+"""Writer-preferring readers-writer lock.
+
+The reference runs every request concurrently (per-request goroutines,
+query/query.go:1684-1714) over posting lists guarded by per-list RWMutex
+(posting/list.go).  Our read path shares immutable device arenas between
+mutations, so the serving layer needs exactly one coarse RW lock: many
+concurrent read-only queries, exclusive mutations.  Python's stdlib has no
+RW lock; this is the classic two-condition implementation with writer
+preference (a waiting writer blocks new readers, so a mutation stream
+cannot be starved by a heavy read load).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+class RWLock:
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._readers = 0          # active readers
+        self._writer = False       # a writer holds the lock
+        self._writers_waiting = 0  # writers queued (blocks new readers)
+
+    def acquire_read(self) -> None:
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self) -> None:
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self) -> None:
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writer = True
+
+    def release_write(self) -> None:
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+    @contextmanager
+    def read(self):
+        self.acquire_read()
+        try:
+            yield
+        finally:
+            self.release_read()
+
+    @contextmanager
+    def write(self):
+        self.acquire_write()
+        try:
+            yield
+        finally:
+            self.release_write()
