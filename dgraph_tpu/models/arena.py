@@ -471,26 +471,32 @@ class ArenaManager:
         self._cache_lock = threading.RLock()
         self._build_locks: Dict[tuple, threading.Lock] = {}
 
-    def _get_or_build(self, cache, key, build):
+    def _get_or_build(self, cache, key, build, valid=None):
         """cache[key], building OUTSIDE the cache lock under a per-key
         build lock: concurrent readers of other keys proceed; concurrent
         readers of the same key wait for one build instead of duplicating
-        it (the pattern of ClusterStore._remote_peek's fetch locks)."""
+        it (the pattern of ClusterStore._remote_peek's fetch locks).
+        ``valid`` optionally rejects a cached entry (sharded_csr checks
+        its source-arena identity).  The build-lock entry is dropped even
+        when the build raises, so a failed build can't wedge the key."""
         lkey = (id(cache), key)
         with self._cache_lock:
             a = cache.get(key)
-            if a is not None:
+            if a is not None and (valid is None or valid(a)):
                 return a
             bl = self._build_locks.setdefault(lkey, threading.Lock())
         with bl:
             with self._cache_lock:
                 a = cache.get(key)
-                if a is not None:
+                if a is not None and (valid is None or valid(a)):
                     return a
-            a = build()
-            with self._cache_lock:
-                cache[key] = a
-                self._build_locks.pop(lkey, None)
+            try:
+                a = build()
+                with self._cache_lock:
+                    cache[key] = a
+            finally:
+                with self._cache_lock:
+                    self._build_locks.pop(lkey, None)
             return a
 
     @_cache_locked
@@ -575,24 +581,14 @@ class ArenaManager:
         from dgraph_tpu.parallel.mesh import shard_arena_rows
 
         a = self.reverse(pred) if reverse else self.data(pred)
-        key = (pred, reverse)
-        lkey = ("sharded", key)
-        with self._cache_lock:
-            cached = self._sharded.get(key)
-            if cached is not None and cached[0] is a:
-                return cached[1]
-            bl = self._build_locks.setdefault(lkey, threading.Lock())
-        with bl:  # shard split outside the cache lock (heavy host work)
-            with self._cache_lock:
-                cached = self._sharded.get(key)
-                if cached is not None and cached[0] is a:
-                    return cached[1]
+
+        def build():
             n_model = self.mesh.shape["model"]
-            sa = shard_arena_rows(a.h_src, a.h_offsets, a.host_dst(), n_model)
-            with self._cache_lock:
-                self._sharded[key] = (a, sa)
-                self._build_locks.pop(lkey, None)
-            return sa
+            return (a, shard_arena_rows(a.h_src, a.h_offsets, a.host_dst(), n_model))
+
+        return self._get_or_build(
+            self._sharded, (pred, reverse), build, valid=lambda e: e[0] is a
+        )[1]
 
     def use_mesh_for(self, arena: CSRArena) -> bool:
         return self.mesh is not None and arena.n_rows >= self.shard_threshold
@@ -659,7 +655,9 @@ class ArenaManager:
         if pd is not None:
             for (uid, _lang), val in pd.values.items():
                 try:
-                    toks = tk.fn(val)
+                    # fulltext analyzes under the VALUE's language tag
+                    # (per-language stemmer+stopwords, tok/fts.go:46-142)
+                    toks = tokmod.tokens_for_value_lang(tk.name, val, _lang)
                 except (ValueError, TypeError, OverflowError):
                     continue  # unindexable value (wrong type, inf, ...)
                 for t in toks:

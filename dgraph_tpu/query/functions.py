@@ -206,8 +206,12 @@ class FuncResolver:
         idx = self._pred_index(pred, prefer_sortable=True)
         tk = tokmod.get_tokenizer(idx.tokenizer)
         if op == "eq" and not tk.sortable:
-            # term/fulltext-indexed eq: token intersection + exact recheck
-            toks = tk.fn(val)
+            # term/fulltext-indexed eq: token intersection + exact recheck.
+            # fulltext tokens reduce under the function's @lang tag, the
+            # same per-language analyzer the index build used
+            # (tok.tokens_for_value_lang) — mismatched stemmers would
+            # miss every lang-tagged value
+            toks = tokmod.tokens_for_value_lang(tk.name, val, fn.lang)
             rows = [idx.row_of(t) for t in toks]
             if any(r < 0 for r in rows) or not rows:
                 return _EMPTY

@@ -17,6 +17,7 @@ from dgraph_tpu.tok.tok import (  # noqa: F401
     has_tokenizer,
     registered,
     tokens_for_value,
+    tokens_for_value_lang,
     term_tokens,
     fulltext_tokens,
     trigram_tokens,

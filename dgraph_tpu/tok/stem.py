@@ -1,9 +1,17 @@
-"""A compact Porter-style stemmer for the fulltext tokenizer.
+"""Compact Snowball-style stemmers for the fulltext tokenizer.
 
-The reference delegates to bleve's snowball stemmers (tok/fts.go:46-142).
-What matters for retrieval correctness is that index build and query use
-the *same* reduction, so a light English stemmer suffices; non-English
-languages get identity (tokens still match exactly).
+The reference delegates to bleve's per-language snowball stemmers
+(tok/fts.go:46-142: one analyzer per language — tokenize, lowercase,
+language stopwords, language stemmer).  We implement light versions of
+the Snowball algorithms for the documented language set below; what
+matters for retrieval correctness is that index build and query apply
+the SAME reduction, and that regular inflections within a language
+actually conflate (Lieder/Liedern → lied).  Unknown languages fall back
+to identity (tokens still match exactly).
+
+Supported: en (Porter), de, fr, es.  Inputs arrive lowercased and
+diacritic-stripped by tok._normalize, so the German umlaut / French
+accent handling of full Snowball is subsumed by normalization.
 """
 
 from __future__ import annotations
@@ -26,9 +34,145 @@ def _has_vowel(s: str) -> bool:
     return any(c in _VOWELS or (c == "y" and i > 0) for i, c in enumerate(s))
 
 
+def _r1(w: str, vowels: str, minpos: int = 0) -> int:
+    """Snowball R1: position after the first non-vowel that follows a
+    vowel (len(w) if none); clamped to ``minpos`` (German uses 3)."""
+    for i in range(1, len(w)):
+        if w[i] not in vowels and w[i - 1] in vowels:
+            return max(i + 1, minpos)
+    return len(w)
+
+
+def _stem_de(w: str) -> str:
+    """Light Snowball German (snowball/german): three suffix steps
+    gated on R1/R2.  Umlauts are already stripped by normalization."""
+    V = "aeiouy"
+    w = w.replace("ß", "ss")
+    r1 = _r1(w, V, 3)
+    r2 = len(w[:r1]) + _r1(w[r1:], V) if r1 < len(w) else len(w)
+    # step 1
+    for suf in ("ern", "em", "er"):
+        if w.endswith(suf) and len(w) - len(suf) >= r1:
+            w = w[: -len(suf)]
+            break
+    else:
+        for suf in ("en", "es", "e"):
+            if w.endswith(suf) and len(w) - len(suf) >= r1:
+                w = w[: -len(suf)]
+                break
+        else:
+            if w.endswith("s") and len(w) - 1 >= r1 and len(w) >= 2 and w[-2] in "bdfghklmnrt":
+                w = w[:-1]
+    # step 2
+    for suf in ("est", "er", "en"):
+        if w.endswith(suf) and len(w) - len(suf) >= r1:
+            w = w[: -len(suf)]
+            break
+    else:
+        if w.endswith("st") and len(w) - 2 >= r1 and len(w) > 5 and w[-3] in "bdfghklmnt":
+            w = w[:-2]
+    # step 3 (derivational, R2)
+    for suf in ("isch", "lich", "heit", "keit", "end", "ung", "ig", "ik"):
+        if w.endswith(suf) and len(w) - len(suf) >= r2:
+            if suf in ("isch", "ig", "ik") and len(w) > len(suf) and w[-len(suf) - 1] == "e":
+                break  # not preceded by e
+            w = w[: -len(suf)]
+            break
+    return w
+
+
+def _stem_fr(w: str) -> str:
+    """Light Snowball French: strip derivational suffixes in R1/R2, then
+    residual verb/plural endings.  Accents already stripped upstream."""
+    V = "aeiouy"
+    # plural -aux forms conflate with the singular (cheval/chevaux,
+    # national/nationaux) before region computation
+    if w.endswith("eaux"):
+        w = w[:-1]
+    elif w.endswith("aux") and len(w) > 4:
+        w = w[:-2] + "l"
+    r1 = _r1(w, V)
+    r2 = len(w[:r1]) + _r1(w[r1:], V) if r1 < len(w) else len(w)
+    for suf, minr in (
+        ("issements", r1), ("issement", r1), ("atrices", r2), ("atrice", r2),
+        ("ateurs", r2), ("ations", r2), ("logies", r2), ("usions", r2),
+        ("ution", r2), ("ateur", r2), ("ation", r2), ("logie", r2),
+        ("ments", r1), ("ment", r1), ("ances", r2), ("iques", r2),
+        ("ismes", r2), ("ables", r2), ("istes", r2), ("ance", r2),
+        ("ique", r2), ("isme", r2), ("able", r2), ("iste", r2),
+        ("eux", r1), ("euses", r1), ("euse", r1), ("ites", r2), ("ite", r2),
+    ):
+        if w.endswith(suf) and len(w) - len(suf) >= minr:
+            w = w[: -len(suf)]
+            break
+    else:
+        # verb endings (RV approximated by R1).  No bare "-ons"/"-et":
+        # they would split noun plurals (chansons/chanson) — a light
+        # stemmer prioritizes noun/adjective consistency over first-person
+        # plural verb conflation.
+        for suf in (
+            "eraient", "assent", "erions", "eront", "erais", "erait",
+            "antes", "aient", "erent", "erons", "asse", "ante", "ants", "ait",
+            "ant", "ees", "era", "iez", "ent", "ais", "ee", "er",
+            "es", "ez", "e",
+        ):
+            if w.endswith(suf) and len(w) - len(suf) >= max(r1, 2):
+                w = w[: -len(suf)]
+                break
+        else:
+            if w.endswith("s") and len(w) - 1 >= 2:
+                w = w[:-1]
+    return w
+
+
+def _stem_es(w: str) -> str:
+    """Light Snowball Spanish: derivational suffixes in R2, then verb
+    endings, then residual vowel."""
+    V = "aeiouy"
+    r1 = _r1(w, V)
+    r2 = len(w[:r1]) + _r1(w[r1:], V) if r1 < len(w) else len(w)
+    for suf in (
+        "amientos", "imientos", "amiento", "imiento", "aciones", "adoras",
+        "adores", "idades", "acion", "adora", "antes", "ancia", "ibles",
+        "istas", "ables", "mente", "ador", "ante", "idad", "able", "ible",
+        "ista", "osos", "osas", "ivas", "ivos", "oso", "osa", "iva", "ivo",
+    ):
+        if w.endswith(suf) and len(w) - len(suf) >= r2:
+            w = w[: -len(suf)]
+            break
+    # verb endings CASCADE after derivational strip so e.g. rapidamente →
+    # rapida → rap reduces identically to the bare adjective rapida
+    for suf in (
+        "aremos", "eremos", "iremos", "asteis", "isteis", "ariamos",
+        "aciones", "ierais", "aramos", "ieron", "iendo", "ando", "aban",
+        "aran", "aria", "arian", "abas", "adas", "idas", "ados", "idos",
+        "amos", "emos", "imos", "aste", "iste", "aba", "ada", "ida",
+        "ado", "ido", "ian", "ara", "are", "ais", "eis", "an", "ar",
+        "er", "ir", "as", "es", "ia", "io",
+    ):
+        if w.endswith(suf) and len(w) - len(suf) >= max(r1, 2):
+            w = w[: -len(suf)]
+            break
+    else:
+        # residual final vowel (snowball's step 3)
+        if w and w[-1] in "aeo" and len(w) - 1 >= max(r1, 2):
+            w = w[:-1]
+    return w
+
+
+_STEMMERS = {"de": _stem_de, "fr": _stem_fr, "es": _stem_es}
+
+# languages with a real stemmer + stopword list (PARITY: the reference
+# ships every snowball language via bleve; we document this set)
+SUPPORTED_LANGS = ("en", "de", "fr", "es")
+
+
 def stem(word: str, lang: str = "en") -> str:
-    if lang != "en" or len(word) <= 2:
+    if len(word) <= 2:
         return word
+    if lang != "en":
+        f = _STEMMERS.get(lang.split("-")[0] if lang else "")
+        return f(word) if f else word
     w = word
 
     # step 1a: plurals

@@ -73,12 +73,16 @@ def term_tokens(s: str) -> List[str]:
 
 def fulltext_tokens(s: str, lang: str = "en") -> List[str]:
     """fulltext: term pipeline + stopword removal + stemming
-    (tok/fts.go:46-142)."""
+    (tok/fts.go:46-142).  The language tag normalizes HERE — region
+    subtags strip ("de-AT" → "de", "en-US" → "en") — so index build and
+    every query surface reduce under identical rules no matter which
+    tag spelling reaches them."""
+    code = (lang or "en").split(",")[0].split("-")[0].lower() or "en"
     out = set()
     for w in _WORD_RE.findall(_normalize(s)):
-        if w in STOPWORDS.get(lang, STOPWORDS["en"]):
+        if w in STOPWORDS.get(code, STOPWORDS["en"]):
             continue
-        out.add(stem(w, lang))
+        out.add(stem(w, code))
     return sorted(out)
 
 
@@ -165,3 +169,15 @@ _register(Tokenizer("datetime", TypeID.DATETIME, 0x4, True, True, _tok_year))
 
 def tokens_for_value(tokenizer: str, v: TypedValue) -> List[Any]:
     return get_tokenizer(tokenizer).fn(v)
+
+
+def tokens_for_value_lang(tokenizer: str, v: TypedValue, lang: str) -> List[Any]:
+    """Index-build tokenization with the VALUE's own language: fulltext
+    values analyze under their lang tag's stopwords + stemmer (the
+    reference's per-language bleve analyzers, tok/fts.go:46-142); every
+    other tokenizer is language-blind.  Query-side tokens use the
+    function's @lang tag (functions.py), so both sides reduce alike."""
+    t = get_tokenizer(tokenizer)
+    if t.name == "fulltext" and lang:
+        return fulltext_tokens(str(convert(v, TypeID.STRING).value), lang)
+    return t.fn(v)

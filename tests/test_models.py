@@ -205,3 +205,54 @@ def test_geo_cells():
     assert geo.matches_filter("within", poly, g)
     assert geo.matches_filter("near", g, g2, max_m=2000)
     assert not geo.matches_filter("near", g, g2, max_m=10)
+
+
+def test_fulltext_per_language_stemming():
+    """Per-language analyzers (tok/fts.go:46-142): the same surface text
+    reduces differently under each language's stemmer, and regular
+    inflections within a language conflate to one token."""
+    from dgraph_tpu import tok
+
+    # German: plural/case inflections conflate
+    assert tok.fulltext_tokens("Lieder", "de") == tok.fulltext_tokens("Liedern", "de")
+    assert tok.fulltext_tokens("Lieder", "de") == tok.fulltext_tokens("Lied", "de")
+    # ... and differ from the English reduction of the same bytes
+    assert tok.fulltext_tokens("Lieder", "de") != tok.fulltext_tokens("Lieder", "en")
+    # French / Spanish
+    assert tok.fulltext_tokens("chansons", "fr") == tok.fulltext_tokens("chanson", "fr")
+    assert tok.fulltext_tokens("canciones", "es") == tok.fulltext_tokens("cancion", "es")
+    # language stopwords apply ("die" is a German stopword, not English)
+    assert tok.fulltext_tokens("die Lieder", "de") == tok.fulltext_tokens("Lieder", "de")
+    assert "die" in tok.fulltext_tokens("die Lieder", "en")
+    # unknown language: identity stemming, still self-consistent
+    assert tok.fulltext_tokens("slova", "cs") == tok.fulltext_tokens("slova", "cs")
+
+
+def test_alloftext_lang_matches_inflections():
+    """alloftext(name@de, ...) matches German inflections end-to-end: the
+    index analyzes each value under ITS lang tag, the query under the
+    function's tag (the round-3 gap: German stemmed with English rules)."""
+    from dgraph_tpu.models import PostingStore
+    from dgraph_tpu.query.engine import QueryEngine
+    from dgraph_tpu.serve.mutations import apply_mutation
+    from dgraph_tpu import gql
+
+    store = PostingStore()
+    eng = QueryEngine(store)
+    apply_mutation(store, gql.parse("""
+    mutation {
+      schema { name: string @index(fulltext) . }
+      set {
+        <0x1> <name> "Alte Lieder"@de .
+        <0x2> <name> "Ein Lied"@de .
+        <0x3> <name> "Songs"@en .
+        <0x4> <name> "Liederlich unrelated"@en .
+      }
+    }
+    """).mutation)
+    out = eng.run('{ q(func: alloftext(name@de, "Lied")) { name@de } }')
+    got = sorted(o["name@de"] for o in out["q"])
+    assert got == ["Alte Lieder", "Ein Lied"], out
+    # singular query form matches the plural value and vice versa
+    out = eng.run('{ q(func: alloftext(name@de, "Liedern")) { name@de } }')
+    assert sorted(o["name@de"] for o in out["q"]) == ["Alte Lieder", "Ein Lied"]
