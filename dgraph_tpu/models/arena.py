@@ -158,6 +158,103 @@ class CSRArena:
         C = ops.CHUNK
         return (self.degree_of_rows(rows) + C - 1) // C
 
+    _inline: Optional[tuple] = None  # lazy (metap, ov_chunks)
+
+    def inline_layout(self) -> tuple:
+        """Inline-head layout for ops.expand_inline, built lazily.
+
+        Returns (metap, ov_chunks): int32[Sb, 8] per-row rows with
+        lane0 = overflow chunk start, lane1 = degree, lanes 2..7 = the
+        first INLINE targets (SENT pad); int32[NCov, 8] overflow chunks
+        (targets INLINE.. of each row), UNPADDED row count.  One row
+        gather serves metadata AND short posting lists — the gather-index
+        halving that lifted the 2-hop bench past the chunked layout
+        (docs/ROOFLINE.md round 4)."""
+        if self._inline is not None:
+            return self._inline
+        with _BUILD_LOCK:
+            if self._inline is not None:
+                return self._inline
+            INL = ops.INLINE
+            S = self.n_rows
+            deg = self.h_offsets[1:] - self.h_offsets[:-1]
+            ovdeg = np.maximum(deg - INL, 0)
+            cdeg = (ovdeg + 7) >> 3
+            coff = np.zeros(S + 1, dtype=np.int64)
+            np.cumsum(cdeg, out=coff[1:])
+            NCov = int(coff[-1])
+            Sb = ops.bucket(max(1, S))
+            metap = np.full((Sb, 8), SENT, dtype=np.int32)
+            metap[:, :2] = 0
+            metap[:S, 0] = coff[:-1]
+            metap[:S, 1] = deg
+            h_dst = self.host_dst() if self.n_edges else np.zeros(0, np.int32)
+            starts = self.h_offsets[:-1]
+            for j in range(INL):
+                sel = deg > j
+                metap[:S][sel, 2 + j] = h_dst[starts[sel] + j]
+            ov = np.full((max(1, NCov), 8), SENT, dtype=np.int32)
+            rows = np.nonzero(deg > INL)[0]
+            if len(rows):
+                # vectorized tail-edge index set (no per-row arange loop):
+                # within = 0..ovdeg-1 per row via the repeat/cumsum trick
+                od = ovdeg[rows]
+                rowid = np.repeat(rows, od)
+                ends = np.cumsum(od)
+                within = np.arange(int(ends[-1]), dtype=np.int64) - np.repeat(
+                    ends - od, od
+                )
+                e = starts[rowid] + INL + within
+                ov[coff[rowid] + (within >> 3), within & 7] = h_dst[e]
+            self._inline = (jnp.asarray(metap), jnp.asarray(ov))
+            return self._inline
+
+    def ov_chunk_degree_of_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Host overflow-chunk-count lookup for inline_layout planning."""
+        d = np.maximum(self.degree_of_rows(rows) - ops.INLINE, 0)
+        return (d + 7) >> 3
+
+    _inline_grouped: Optional[tuple] = None
+
+    def inline_layout_grouped(self) -> tuple:
+        """inline_layout with skey-coded target lanes (ops.skey_encode):
+        stored targets carry the no-overflow group bit, so sorting an
+        expansion's output groups overflow-bearing rows into an ascending
+        prefix and ops.expand_inline_grouped can run its slot-map on that
+        prefix alone.  Dense arenas only (row i == uid i) with uids below
+        2^GROUP_BIT — raises ValueError beyond that; callers must catch
+        it and use inline_layout() (bench.py does)."""
+        if self._inline_grouped is not None:
+            return self._inline_grouped
+        from dgraph_tpu.ops.sets import GROUP_BIT, skey_encode
+
+        max_uid = self.n_rows
+        if self.n_edges:
+            max_uid = max(max_uid, int(self.host_dst().max()) + 1)
+        if max_uid >= (1 << GROUP_BIT):
+            raise ValueError(
+                f"uid space too large for grouped inline layout "
+                f"({max_uid} >= 2^{GROUP_BIT}); use inline_layout()"
+            )
+        with _BUILD_LOCK:
+            if self._inline_grouped is not None:
+                return self._inline_grouped
+            metap_j, ov_j = self.inline_layout()
+            metap = np.asarray(metap_j).copy()
+            ov = np.asarray(ov_j).copy()
+            S = self.n_rows
+            deg = self.h_offsets[1:] - self.h_offsets[:-1]
+            # overflow bit by TARGET uid; uids without a row have no edges,
+            # hence no overflow
+            has_ov_of_uid = np.zeros(max_uid + 1, bool)
+            has_ov_of_uid[:S] = deg > ops.INLINE
+            for tab in (metap[:, 2:], ov):
+                valid = tab != SENT
+                u = tab[valid]
+                tab[valid] = skey_encode(u, has_ov_of_uid[u])
+            self._inline_grouped = (jnp.asarray(metap), jnp.asarray(ov))
+            return self._inline_grouped
+
     _lut: Optional[jnp.ndarray] = None
 
     def lut(self, universe: int) -> jnp.ndarray:
@@ -242,6 +339,8 @@ class CSRArena:
         self.n_edges = len(h_dst)
         # derived device structures are stale until next device use
         self._chunked = None
+        self._inline = None
+        self._inline_grouped = None
         self._lut = None
         self._n_distinct_dst = None
         if hasattr(self, "_topm_cdeg"):

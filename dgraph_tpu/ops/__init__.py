@@ -9,9 +9,18 @@ chasing over variable-length slices.
 
 from dgraph_tpu.ops.sets import (  # noqa: F401
     CHUNK,
+    INLINE,
     SENT,
     bucket,
+    bucket_fine,
     expand_chunked,
+    expand_inline,
+    expand_inline_grouped,
+    skey_encode,
+    skey_uid,
+    GROUP_BIT,
+    GROUP_MASK,
+    sort_desc_free,
     pad_to,
     pad_rows,
     compact,

@@ -48,6 +48,22 @@ def bucket(n: int, floor: int = 8) -> int:
     return b
 
 
+def bucket_fine(n: int, floor: int = 8) -> int:
+    """Round ``n`` up to a 1/8-step of a power of two (>= floor).
+
+    Pow2 bucketing wastes up to 2× of every capacity-proportional cost
+    (gather indices, scan length, sort width); 1/8 steps cap the waste at
+    12.5% for 8× the jit-cache shapes.  Use where one compiled program
+    serves a long batch (bench.py, bulk pipelines); latency-sensitive
+    mixed query streams keep ``bucket``."""
+    if n <= floor:
+        return floor
+    k = (int(n) - 1).bit_length() - 1
+    base = 1 << k
+    step = max(1, base >> 3)
+    return base + -(-(n - base) // step) * step
+
+
 def pad_to(x: np.ndarray, size: int, fill: int = SENT) -> np.ndarray:
     """Pad a host int array to ``size`` with ``fill`` (host-side helper)."""
     x = np.asarray(x, dtype=np.int32)
@@ -71,7 +87,7 @@ def count_valid(x: jnp.ndarray) -> jnp.ndarray:
 @jax.jit
 def compact(x: jnp.ndarray) -> jnp.ndarray:
     """Re-establish the invariant after masking: sort so SENT pads the tail."""
-    return jnp.sort(x)
+    return sort_desc_free(x)
 
 
 @jax.jit
@@ -81,9 +97,9 @@ def sort_unique(x: jnp.ndarray) -> jnp.ndarray:
     Equivalent of the dedup in algo.MergeSorted (algo/uidlist.go:249-296),
     done as: sort, mark adjacent duplicates, replace with SENT, re-sort.
     """
-    x = jnp.sort(x)
+    x = sort_desc_free(x)
     dup = jnp.concatenate([jnp.zeros((1,), dtype=bool), x[1:] == x[:-1]])
-    return jnp.sort(jnp.where(dup, SENT, x))
+    return sort_desc_free(jnp.where(dup, SENT, x))
 
 
 @jax.jit
@@ -105,14 +121,14 @@ def intersect(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     (algo/uidlist.go:42-181) with one uniform vectorized binary search —
     the adaptivity is pointless on SIMD hardware where all lanes run anyway.
     """
-    return jnp.sort(jnp.where(member_mask(a, b), a, SENT))
+    return sort_desc_free(jnp.where(member_mask(a, b), a, SENT))
 
 
 @jax.jit
 def difference(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """a \\ b for sorted-unique-padded sets (algo.Difference, uidlist.go:217)."""
     keep = (~member_mask(a, b)) & (a != SENT)
-    return jnp.sort(jnp.where(keep, a, SENT))
+    return sort_desc_free(jnp.where(keep, a, SENT))
 
 
 @jax.jit
@@ -242,7 +258,7 @@ def unique_rows_sorted(x: jnp.ndarray) -> jnp.ndarray:
     capacity (harmless: skip rows cost nothing in the expansion kernel).
     This is the frontier-dedup that replaces unique_dense on the 2-hop
     hot path (TPU scatters serialize; sorts ride the VPU)."""
-    x = jnp.sort(x)
+    x = sort_desc_free(x)
     first = jnp.concatenate([jnp.ones((1,), dtype=bool), x[1:] != x[:-1]])
     keep = first & (x != SENT)
     return jnp.where(keep, x, -1).astype(jnp.int32)
@@ -340,6 +356,130 @@ def expand_chunked(
     )
     seg = pos_of_ord[jnp.clip(k, 0, nrows - 1)]
     return out, total, jnp.where(ok, seg, -1)
+
+
+INLINE = 6  # inline posting-head lanes in the meta-plus row (32B granule)
+
+
+def expand_inline(
+    metap: jnp.ndarray,
+    ov_chunks: jnp.ndarray,
+    rows: jnp.ndarray,
+    capc: int,
+):
+    """Inline-head expansion: the round-4 fast path of the posting gather.
+
+    The decisive cost on TPU is gather-engine index rate (~5-20ns per
+    32-byte row regardless of locality — measured, docs/ROOFLINE.md), and
+    expand_chunked paid TWO row gathers per frontier row (meta + >= 1
+    chunk) even though the mean posting list is ~8 long.  This layout
+    inlines the first INLINE targets INTO the metadata row, so one gather
+    serves both metadata and the whole list for short rows; only rows
+    with degree > INLINE touch the 8-wide overflow chunk table.  Against
+    the same worker/task.go:287-440 baseline semantics, hop-level gather
+    index counts drop ~2x (bench.py: 2.855x -> beyond 6x vs CPU).
+
+    Layout (CSRArena.inline_layout):
+      metap:     int32[S, 8] - lane0 = overflow chunk start, lane1 =
+                 degree (overflow chunk count derives on device:
+                 ceil(max(0, deg-INLINE)/8)), lanes 2..7 = first INLINE
+                 targets ascending, SENT-padded.
+      ov_chunks: int32[NCov, 8] - targets INLINE.. of each row, 8 per
+                 chunk, ascending, SENT pad lanes; UNPADDED row count
+                 (pow2-padding the table costs gather rate, not just HBM).
+
+    Args:
+      rows: int32[B] row ids, ascending over valid entries, DISTINCT;
+            -1 = skip (anywhere).
+      capc: static overflow-chunk capacity.
+
+    Returns:
+      inline: int32[B, INLINE] inline targets (SENT pad).
+      ov:     int32[capc, 8] overflow targets (SENT pad).
+      total:  int32 - true edge count (sum of degrees).
+
+    This is exactly the grouped kernel with the slot-map prefix spanning
+    every row (one shared implementation — the scan/scatter chain lives
+    only in expand_inline_grouped).
+    """
+    return expand_inline_grouped(metap, ov_chunks, rows, capc, rows.shape[0])
+
+
+# Grouped (skey) coding for inline arenas: stored target ids carry a
+# "no-overflow" bit above the uid so one value sort groups rows WITH
+# overflow chunks into an ascending prefix — the slot-map scatter then
+# runs on a short static prefix instead of the whole frontier.  Capacity:
+# uid < 2^22 (≈4.2M rows per arena shard; bigger arenas use the plain
+# layout).  SENT still sorts last (2^23 << SENT).
+GROUP_BIT = 22
+GROUP_MASK = (1 << GROUP_BIT) - 1
+
+
+def skey_encode(uids: np.ndarray, has_ov: np.ndarray) -> np.ndarray:
+    """Host-side: pack uid + no-overflow group bit (see GROUP_BIT)."""
+    return (uids | (np.where(has_ov, 0, 1) << GROUP_BIT)).astype(np.int32)
+
+
+@jax.jit
+def skey_uid(v: jnp.ndarray) -> jnp.ndarray:
+    """Decode a packed skey lane to its uid; SENT passes through."""
+    return jnp.where(v == SENT, SENT, v & GROUP_MASK)
+
+
+@partial(jax.jit, static_argnames=("capc", "pcap"))
+def expand_inline_grouped(
+    metap: jnp.ndarray,
+    ov_chunks: jnp.ndarray,
+    rows: jnp.ndarray,
+    capc: int,
+    pcap: int,
+):
+    """expand_inline over a GROUP-ORDERED frontier: every row with
+    overflow chunks sits in ``rows[:pcap]`` (what sorting skey-coded
+    values produces — see skey_encode).  The metadata gather still covers
+    every row (inline lanes), but the overflow slot-map — cumsum, cummax
+    and the scatter, the expensive scan chain — runs only on the
+    productive prefix.  Outputs carry skey-coded targets; decode with
+    skey_uid.
+
+    rows beyond pcap MUST have degree <= INLINE (grouping invariant);
+    rows: ascending-distinct within each group, -1 skips anywhere."""
+    nc = ov_chunks.shape[0]
+    valid = rows >= 0
+    r = jnp.where(valid, rows, 0)
+    m = metap[r]  # [B, 8] one gather serves inline heads + metadata
+    inline = jnp.where(valid[:, None], m[:, 2:], SENT)
+    dg = jnp.where(valid, m[:, 1], 0)
+    total = jnp.sum(dg).astype(jnp.int32)
+    # overflow slot-map on the prefix only
+    vp = valid[:pcap]
+    cs = jnp.where(vp, m[:pcap, 0], 0)
+    cd = (jnp.maximum(jnp.where(vp, dg[:pcap], 0) - INLINE, 0) + 7) >> 3
+    ccum = jnp.cumsum(cd)
+    cstart = ccum - cd
+    productive = cd > 0
+    end = jnp.where(productive, cs + cd, 0)
+    pe = jnp.concatenate([jnp.zeros((1,), end.dtype), jax.lax.cummax(end)[:-1]])
+    slot = jnp.where(productive, cstart, capc)
+    dvec = (
+        jnp.zeros((capc,), dtype=jnp.int32)
+        .at[slot]
+        .set(jnp.where(productive, cs - pe, 0).astype(jnp.int32), mode="drop")
+    )
+    i = jnp.arange(capc, dtype=jnp.int32)
+    chunkid = jnp.cumsum(dvec) + i
+    ok = i < ccum[-1]
+    ov = ov_chunks[jnp.clip(jnp.where(ok, chunkid, 0), 0, nc - 1)]
+    ov = jnp.where(ok[:, None], ov, SENT)
+    return inline, ov, total
+
+
+def sort_desc_free(x: jnp.ndarray) -> jnp.ndarray:
+    """Ascending value sort WITHOUT the stability iota: jnp.sort lowers to
+    a stable two-operand (value, iota) sort — measurably slower on TPU.
+    Set kernels only ever sort bare values, where stability is
+    meaningless, so they use this."""
+    return jax.lax.sort(x, dimension=x.ndim - 1, is_stable=False)
 
 
 @jax.jit

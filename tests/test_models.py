@@ -256,3 +256,27 @@ def test_alloftext_lang_matches_inflections():
     # singular query form matches the plural value and vice versa
     out = eng.run('{ q(func: alloftext(name@de, "Liedern")) { name@de } }')
     assert sorted(o["name@de"] for o in out["q"]) == ["Alte Lieder", "Ein Lied"]
+
+
+def test_uid_space_ceiling_guard():
+    """The dense allocator fails loudly near int32 exhaustion (never a
+    silent wraparound into arena row chaos) and keeps exact ids at
+    >100M synthetic uids."""
+    import pytest
+    from dgraph_tpu.models.uids import UidMap, UidSpaceExhausted, UID_CEILING
+
+    m = UidMap()
+    # jump the space to >100M without allocating 100M dict entries
+    m.reserve_through(150_000_000)
+    u = m.fresh(1)[0]
+    assert u == 150_000_001  # exact, no drift at scale
+    assert m.assign("x150M") == 150_000_002
+    # warn-then-raise at the ceiling
+    m.reserve_through(UID_CEILING - 1)
+    assert m.fresh(1)[0] == UID_CEILING  # last assignable uid
+    with pytest.raises(UidSpaceExhausted):
+        m.fresh(1)
+    with pytest.raises(UidSpaceExhausted):
+        m.assign("over-the-top")
+    with pytest.raises(UidSpaceExhausted):
+        m.reserve_through(UID_CEILING + 5)

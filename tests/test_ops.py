@@ -249,3 +249,92 @@ def test_expand_chunked_two_hop_matches_scalar(rng):
     flat = np.asarray(ops.sort_unique(o2.reshape(-1)))
     got = flat[flat != SENT]
     np.testing.assert_array_equal(got, np.unique(out2))
+
+
+def test_expand_inline_matches_reference():
+    """expand_inline (inline-head layout) reproduces the reference CSR
+    expansion exactly: inline ∪ overflow lanes = the row's full target
+    multiset, totals exact, -1 skips honored, across degree edge cases
+    (0, 1, INLINE, INLINE+1, INLINE+8, big)."""
+    import numpy as np
+    import jax
+    from dgraph_tpu import ops
+    from dgraph_tpu.models.arena import csr_from_edges
+    from dgraph_tpu.ops.sets import SENT
+
+    rng = np.random.default_rng(11)
+    # degrees hitting every boundary around INLINE and chunk width
+    # (0-degree uids simply have no row in the arena)
+    degs = [1, ops.INLINE - 1, ops.INLINE, ops.INLINE + 1,
+            ops.INLINE + 7, ops.INLINE + 8, ops.INLINE + 9, 40, 100]
+    src, dst = [], []
+    for u, d in enumerate(degs):
+        tgts = rng.choice(5000, size=d, replace=False)
+        src += [u + 1] * d
+        dst += list(tgts)
+    a = csr_from_edges(np.array(src, np.int64), np.array(dst, np.int64))
+    metap, ov = a.inline_layout()
+    # expand every row + skips, ascending-distinct with -1 interleaved
+    rows = np.array([0, -1, 1, 2, 3, -1, 4, 5, 6, 7, 8, -1], np.int32)
+    capc = int(a.ov_chunk_degree_of_rows(rows).sum()) or 1
+    capc = ops.bucket_fine(capc)
+    inline, ovout, total = ops.expand_inline(metap, ov, jax.device_put(rows), capc)
+    inline, ovout = np.asarray(inline), np.asarray(ovout)
+    got = np.concatenate([inline.reshape(-1), ovout.reshape(-1)])
+    got = np.sort(got[got != SENT])
+    want, _ = a.expand_host(rows)
+    assert int(total) == len(want)
+    assert np.array_equal(got, np.sort(want.astype(np.int32)))
+    # per-row: inline lanes hold the FIRST min(deg, INLINE) targets ascending
+    for i, r in enumerate(rows):
+        if r < 0:
+            assert (inline[i] == SENT).all()
+            continue
+        tgts = np.sort(np.asarray(a.expand_host(np.array([r]))[0]))
+        head = inline[i][inline[i] != SENT]
+        assert np.array_equal(head, tgts[: len(head)].astype(np.int32))
+        assert len(head) == min(len(tgts), ops.INLINE)
+
+
+def test_bucket_fine_steps():
+    from dgraph_tpu.ops.sets import bucket_fine, bucket
+
+    assert bucket_fine(1) == 8 and bucket_fine(8) == 8
+    assert bucket_fine(9) == 9  # 8 + step(1)
+    assert bucket_fine(22008) == 22528  # < bucket's 32768
+    assert bucket_fine(1 << 20) == 1 << 20
+    for n in (17, 100, 5000, 22008, 70000):
+        b = bucket_fine(n)
+        assert n <= b <= bucket(n)
+        assert b - n <= max(1, b >> 3)
+    assert bucket_fine(3) == 8  # floor
+
+
+def test_expand_inline_grouped_matches_reference():
+    """Grouped (skey) expansion == plain expansion after decode: the
+    group bit only reorders work, never changes the produced multiset."""
+    import numpy as np
+    import jax
+    from dgraph_tpu import ops
+    from dgraph_tpu.models.arena import csr_dense_from_edges
+    from dgraph_tpu.ops.sets import SENT, GROUP_MASK
+
+    rng = np.random.default_rng(5)
+    n = 500
+    src = rng.integers(1, n, size=4000)
+    dst = rng.integers(1, n, size=4000)
+    a = csr_dense_from_edges(src, dst, n)
+    metap, ov = a.inline_layout_grouped()
+    deg = (a.h_offsets[1:] - a.h_offsets[:-1])
+    f = np.unique(rng.integers(1, n, size=64))
+    key = np.asarray(ops.skey_encode(f, deg[f] > ops.INLINE))
+    f = f[np.argsort(key)]
+    pcap = ops.bucket_fine(int((deg[f] > ops.INLINE).sum()))
+    capc = ops.bucket_fine(int(a.ov_chunk_degree_of_rows(f).sum()) or 1)
+    rows = jax.device_put(np.asarray(f, np.int32))
+    inline, ovout, total = ops.expand_inline_grouped(metap, ov, rows, capc, pcap)
+    got = np.concatenate([np.asarray(inline).reshape(-1), np.asarray(ovout).reshape(-1)])
+    got = got[got != SENT] & int(GROUP_MASK)
+    want, _ = a.expand_host(f)
+    assert int(total) == len(want)
+    assert np.array_equal(np.sort(got), np.sort(want.astype(np.int32)))
