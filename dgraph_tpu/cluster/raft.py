@@ -47,6 +47,11 @@ class VoteReq:
     candidate: str
     last_log_index: int
     last_log_term: int
+    # pre-vote (raft thesis §9.6 / etcd PreVote, the refinement the
+    # reference gets from etcd/raft): a probe at term+1 that mutates NO
+    # persistent state — a partitioned node rejoining cannot inflate the
+    # cluster term and force a needless election
+    pre: bool = False
 
 
 @dataclass
@@ -54,6 +59,18 @@ class VoteResp:
     term: int
     granted: bool
     sender: str
+    pre: bool = False
+
+
+@dataclass
+class TimeoutNow:
+    """Leadership transfer (draft.go:788-805 TransferLeadership): the
+    leader tells its most caught-up follower to campaign IMMEDIATELY
+    (bypassing pre-vote and its own election timer), so a graceful stop
+    hands off leadership with no availability gap."""
+
+    term: int
+    leader: str
 
 
 @dataclass
@@ -308,6 +325,15 @@ class RaftNode:
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
         self.votes: set = set()
+        self._prevotes: set = set()
+        self._prevoting = False  # an open pre-vote round of OUR own
+        # ticks since we last heard from a live leader — the pre-vote
+        # stickiness clock.  Deliberately separate from _elapsed, which
+        # our own election activity resets (etcd tracks these apart too).
+        self._since_leader = 0
+        self._transfer_target: Optional[str] = None
+        self._transfer_ticks = 0
+        self._transfer_sent = False
         self._elapsed = 0
         self._timeout = self._rand_timeout()
         self._inbox: "queue.Queue" = queue.Queue()
@@ -328,10 +354,33 @@ class RaftNode:
         self._thread.start()
 
     def stop(self) -> None:
+        if self._stop.is_set():  # idempotent: tests/admin can double-stop
+            return
+        # graceful-stop leadership transfer (draft.go:788-805): hand the
+        # lead to the most caught-up follower and wait briefly for its
+        # first heartbeat to demote us, so the group never waits out an
+        # election timeout.  Crash-stops skip this naturally (no stop()).
+        if self.state == LEADER and self.peers and self._thread is not None:
+            self._transfer_sent = False
+            self._inbox.put(("transfer",))
+            deadline = time.time() + 2.0
+            # exit on demotion (new leader's message reached us) OR once
+            # TimeoutNow has flown plus a short grace — when our inbound
+            # plane is already closing we can't observe the demotion, and
+            # the handoff itself completes on the survivors' side
+            while self.state == LEADER and time.time() < deadline:
+                if self._transfer_sent:
+                    time.sleep(self.tick_s * 4)
+                    break
+                time.sleep(self.tick_s)
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.storage.close()
+
+    def transfer_leadership(self) -> None:
+        """Ask the most caught-up follower to take over (TimeoutNow)."""
+        self._inbox.put(("transfer",))
 
     # -- public API (thread-safe) -------------------------------------------
 
@@ -389,6 +438,8 @@ class RaftNode:
                     self._handle_conf_add(item[1])
                 elif kind == "conf_remove":
                     self._handle_conf_remove(item[1])
+                elif kind == "transfer":
+                    self._handle_transfer()
             except Exception:  # noqa: BLE001 — a bad entry/storage error must
                 # not silently kill the event loop and wedge the group
                 import traceback
@@ -402,13 +453,18 @@ class RaftNode:
 
     def _tick(self) -> None:
         if self.state == LEADER:
+            if self._transfer_target is not None:
+                self._transfer_ticks -= 1
+                if self._transfer_ticks <= 0:
+                    self._finish_transfer()  # best effort at deadline
             self._broadcast_append()
             return
         if self.passive:
             return  # joining node: wait to be contacted, never campaign
         self._elapsed += 1
+        self._since_leader += 1
         if self._elapsed >= self._timeout:
-            self._campaign()
+            self._prevote()
 
     def _handle_conf_add(self, nid: str) -> None:
         if nid == self.node_id:
@@ -425,6 +481,29 @@ class RaftNode:
         # learning a real peer activates a passive joiner
         self.passive = False
 
+    def _handle_transfer(self) -> None:
+        if self.state != LEADER or not self.peers:
+            return
+        # flush our tail, pick the most caught-up peer, and hand off only
+        # once it confirms our last index (etcd waits for catch-up before
+        # MsgTimeoutNow); a tick-bounded deadline fires best-effort if the
+        # confirmation never lands
+        self._broadcast_append()
+        target = max(self.peers, key=lambda p: self.match_index.get(p, 0))
+        self._transfer_target = target
+        self._transfer_ticks = self.election_ticks
+        if self.match_index.get(target, 0) >= self.storage.last_index():
+            self._finish_transfer()
+
+    def _finish_transfer(self) -> None:
+        target = self._transfer_target
+        self._transfer_target = None
+        if target is not None and self.state == LEADER:
+            self.transport.send(
+                target, self.group, TimeoutNow(self.storage.term, self.node_id)
+            )
+        self._transfer_sent = True
+
     def _handle_conf_remove(self, nid: str) -> None:
         if nid == self.node_id or nid not in self.peers:
             return
@@ -437,6 +516,26 @@ class RaftNode:
             self._maybe_commit()
 
     # -- elections ----------------------------------------------------------
+
+    def _prevote(self) -> None:
+        """Probe electability at term+1 without touching persistent state;
+        only a pre-vote majority starts a real (term-bumping) campaign."""
+        if not self.peers:
+            self._campaign()
+            return
+        self._prevotes = {self.node_id}
+        self._prevoting = True
+        self._elapsed = 0
+        self._timeout = self._rand_timeout()
+        req = VoteReq(
+            term=self.storage.term + 1,
+            candidate=self.node_id,
+            last_log_index=self.storage.last_index(),
+            last_log_term=self.storage.last_term(),
+            pre=True,
+        )
+        for p in self.peers:
+            self.transport.send(p, self.group, req)
 
     def _campaign(self) -> None:
         if not self.peers:  # single-node group: self-elect immediately
@@ -460,6 +559,10 @@ class RaftNode:
     def _become_leader(self) -> None:
         self.state = LEADER
         self.leader_id = self.node_id
+        self._prevoting = False
+        self._prevotes = set()
+        self._transfer_target = None
+        self._transfer_ticks = 0
         nxt = self.storage.last_index() + 1
         self.next_index = {p: nxt for p in self.peers}
         self.match_index = {p: 0 for p in self.peers}
@@ -474,8 +577,14 @@ class RaftNode:
         self.state = FOLLOWER
         if leader is not None:
             self.leader_id = leader
+            self._since_leader = 0  # heard from a live leader just now
         self._elapsed = 0
         self._timeout = self._rand_timeout()
+        self._prevoting = False
+        self._prevotes = set()
+        # a transfer begun under an old leadership must not fire later
+        self._transfer_target = None
+        self._transfer_ticks = 0
         if was_leader:
             err = RuntimeError("leadership lost")
             for fut in self._pending.values():
@@ -563,8 +672,44 @@ class RaftNode:
             self._on_snapshot(msg)
         elif isinstance(msg, SnapshotResp):
             self._on_snapshot_resp(msg)
+        elif isinstance(msg, TimeoutNow):
+            self._on_timeout_now(msg)
+
+    def _on_timeout_now(self, m: TimeoutNow) -> None:
+        """Transfer target: campaign NOW, bypassing pre-vote and the
+        election timer (we were chosen as most caught-up; the old leader
+        is about to stop)."""
+        if m.term < self.storage.term or self.state == LEADER:
+            return
+        if self.passive or not self.peers:
+            # a joiner that has not learned the membership yet would
+            # "win" a single-node election and split-brain — ignore
+            return
+        self._campaign()
 
     def _on_vote_req(self, m: VoteReq) -> None:
+        if m.pre:
+            # pre-vote: assess, mutate NOTHING persistent.  Reject while
+            # this node believes a live leader exists (heard from it
+            # within the minimum election timeout) — leader stickiness,
+            # the property that makes rejoining nodes non-disruptive.
+            up_to_date = (m.last_log_term, m.last_log_index) >= (
+                self.storage.last_term(),
+                self.storage.last_index(),
+            )
+            leader_alive = (
+                self.state == LEADER
+                or (
+                    self.leader_id is not None
+                    and self._since_leader < self.election_ticks
+                )
+            )
+            grant = m.term >= self.storage.term and up_to_date and not leader_alive
+            self.transport.send(
+                m.candidate, self.group,
+                VoteResp(self.storage.term, grant, self.node_id, pre=True),
+            )
+            return
         if m.term < self.storage.term:
             self.transport.send(
                 m.candidate, self.group,
@@ -586,6 +731,26 @@ class RaftNode:
         )
 
     def _on_vote_resp(self, m: VoteResp) -> None:
+        if m.pre:
+            if m.term > self.storage.term:
+                # a rejection from a higher-term node: adopt the term so
+                # a later REAL campaign is viable (without this, a stale
+                # node with the freshest log can deadlock the election)
+                self._step_down(m.term)
+                return
+            if (
+                self._prevoting  # stale grants after the round closed
+                # (e.g. a live leader re-acknowledged us) must not count
+                and m.granted
+                and self.state != LEADER
+                and m.term <= self.storage.term + 1
+            ):
+                self._prevotes.add(m.sender)
+                if len(self._prevotes) * 2 > len(self.peers) + 1:
+                    self._prevotes = set()
+                    self._prevoting = False
+                    self._campaign()
+            return
         if self.state != CANDIDATE or m.term != self.storage.term:
             if m.term > self.storage.term:
                 self._step_down(m.term)
@@ -642,6 +807,13 @@ class RaftNode:
             )
             self.next_index[m.sender] = self.match_index[m.sender] + 1
             self._maybe_commit()
+            # pending leadership transfer: hand off the moment the chosen
+            # target confirms our whole log
+            if (
+                self._transfer_target == m.sender
+                and self.match_index[m.sender] >= self.storage.last_index()
+            ):
+                self._finish_transfer()
         else:
             # back off; a truthy hint is the follower's snap_index + 1
             # (jump straight there), 0 means plain log mismatch

@@ -18,6 +18,7 @@ from typing import Dict, List, Optional
 
 from dgraph_tpu.models import codec
 from dgraph_tpu.cluster.raft import (
+    TimeoutNow,
     AppendReq,
     AppendResp,
     Entry,
@@ -28,7 +29,8 @@ from dgraph_tpu.cluster.raft import (
     VoteResp,
 )
 
-_VOTE_REQ, _VOTE_RESP, _APPEND_REQ, _APPEND_RESP, _SNAP_REQ, _SNAP_RESP = range(6)
+(_VOTE_REQ, _VOTE_RESP, _APPEND_REQ, _APPEND_RESP, _SNAP_REQ, _SNAP_RESP,
+ _TIMEOUT_NOW) = range(7)
 
 # Header carrying the shared cluster secret on every intra-cluster call.
 # The raft/propose/assign endpoints share the public port (the reference
@@ -107,11 +109,17 @@ def encode_msg(msg) -> bytes:
         _put_str(buf, msg.candidate)
         codec.put_uvarint(buf, msg.last_log_index)
         codec.put_uvarint(buf, msg.last_log_term)
+        buf.append(1 if msg.pre else 0)
     elif isinstance(msg, VoteResp):
         buf.append(_VOTE_RESP)
         codec.put_uvarint(buf, msg.term)
         buf.append(1 if msg.granted else 0)
         _put_str(buf, msg.sender)
+        buf.append(1 if msg.pre else 0)
+    elif isinstance(msg, TimeoutNow):
+        buf.append(_TIMEOUT_NOW)
+        codec.put_uvarint(buf, msg.term)
+        _put_str(buf, msg.leader)
     elif isinstance(msg, AppendReq):
         buf.append(_APPEND_REQ)
         codec.put_uvarint(buf, msg.term)
@@ -155,12 +163,20 @@ def decode_msg(b: bytes):
         cand, pos = _get_str(b, pos)
         lli, pos = codec.uvarint(b, pos)
         llt, pos = codec.uvarint(b, pos)
-        return VoteReq(term, cand, lli, llt)
+        # trailing pre byte absent in pre-round-4 frames: degrade to a
+        # plain vote instead of crashing the receive path mid-upgrade
+        pre = pos < len(b) and b[pos] == 1
+        return VoteReq(term, cand, lli, llt, pre)
     if tag == _VOTE_RESP:
         term, pos = codec.uvarint(b, pos)
         granted = b[pos] == 1
         sender, pos = _get_str(b, pos + 1)
-        return VoteResp(term, granted, sender)
+        pre = pos < len(b) and b[pos] == 1
+        return VoteResp(term, granted, sender, pre)
+    if tag == _TIMEOUT_NOW:
+        term, pos = codec.uvarint(b, pos)
+        leader, pos = _get_str(b, pos)
+        return TimeoutNow(term, leader)
     if tag == _APPEND_REQ:
         term, pos = codec.uvarint(b, pos)
         leader, pos = _get_str(b, pos)

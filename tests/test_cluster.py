@@ -236,3 +236,89 @@ def test_raft_apply_error_resolves_future_and_continues(tmp_path):
         assert applied == [b"ok1", b"ok2"]
     finally:
         node.stop()
+
+
+def test_raft_prevote_rejoin_does_not_disrupt(tmp_path):
+    """Pre-vote (raft §9.6, etcd PreVote): a partitioned follower that
+    times out repeatedly must NOT inflate the cluster term — on heal, the
+    established leader keeps leading at its original term (the round-3
+    gap: a restarting node forced a needless election)."""
+    import time as _t
+
+    tr, groups = _cluster(tmp_path)
+    try:
+        assert wait_for(lambda: _leader(groups) is not None)
+        ld = _leader(groups)
+        term0 = ld.node.storage.term
+        isolated = next(g for g in groups if g is not ld)
+        for g in groups:
+            if g is not isolated:
+                tr.cut(isolated.node.node_id, g.node.node_id)
+        # let the isolated node time out MANY times (pre-vote fails, no
+        # term bump; without pre-vote it would campaign at term+1, +2, ...)
+        time.sleep(isolated.node.tick_s * isolated.node.election_ticks * 8)
+        assert isolated.node.storage.term == term0  # no inflation while cut
+        tr.heal()
+        time.sleep(isolated.node.tick_s * isolated.node.election_ticks * 3)
+        # same leader, same term: the rejoin was non-disruptive
+        assert ld.node.is_leader
+        assert ld.node.storage.term == term0
+        # and the cluster still accepts writes
+        ld.propose_edges([Edge(pred="pv", src=1, dst=2)])
+        assert wait_for(
+            lambda: all(g.store.neighbors("pv", 1) == [2] for g in groups)
+        )
+    finally:
+        for g in groups:
+            g.stop()
+
+
+def test_raft_leadership_transfer_on_graceful_stop(tmp_path):
+    """Planned shutdown hands leadership off with no availability gap
+    (draft.go:788-805 TransferLeadership): by the time stop() returns, a
+    survivor is already leader and accepts proposals immediately."""
+    tr, groups = _cluster(tmp_path)
+    try:
+        assert wait_for(lambda: _leader(groups) is not None)
+        old = _leader(groups)
+        survivors = [g for g in groups if g is not old]
+        old.stop()
+        # no election-timeout wait: a new leader exists (essentially)
+        # immediately after the graceful stop returns
+        t0 = time.time()
+        assert wait_for(lambda: _leader(survivors) is not None, timeout=2)
+        handoff_s = time.time() - t0
+        new_leader = _leader(survivors)
+        new_leader.propose_edges([Edge(pred="xfer", src=3, dst=4)])
+        assert wait_for(
+            lambda: all(g.store.neighbors("xfer", 3) == [4] for g in survivors)
+        )
+        # the handoff beat a cold election: well under one election timeout
+        assert handoff_s < old.node.tick_s * old.node.election_ticks
+    finally:
+        for g in groups:
+            g.stop()
+
+
+def test_raft_wire_codec_roundtrips_new_messages():
+    """encode_msg/decode_msg round-trip the round-4 frames (pre-vote
+    bytes, TimeoutNow) and degrade old frames without crashing — the
+    InMemoryTransport tests never touch the codec, so this does."""
+    from dgraph_tpu.cluster.raft import TimeoutNow, VoteReq, VoteResp
+    from dgraph_tpu.cluster.transport import decode_msg, encode_msg
+
+    for msg in (
+        VoteReq(7, "n1", 42, 6, pre=True),
+        VoteReq(7, "n1", 42, 6, pre=False),
+        VoteResp(7, True, "n2", pre=True),
+        VoteResp(7, False, "n2", pre=False),
+        TimeoutNow(9, "n3"),
+    ):
+        assert decode_msg(encode_msg(msg)) == msg
+    # frames from a pre-round-4 build lack the trailing pre byte: decode
+    # as plain (non-pre) votes instead of crashing the receive path
+    old_req = encode_msg(VoteReq(7, "n1", 42, 6, pre=False))[:-1]
+    got = decode_msg(old_req)
+    assert got == VoteReq(7, "n1", 42, 6, pre=False)
+    old_resp = encode_msg(VoteResp(7, True, "n2", pre=False))[:-1]
+    assert decode_msg(old_resp) == VoteResp(7, True, "n2", pre=False)
