@@ -34,6 +34,9 @@ def build_options(argv=None) -> Options:
                    help="(reserved) separate wal dir; DurableStore keeps wal beside postings")
     p.add_argument("--export", dest="export_path", default=d.export_path)
     p.add_argument("--port", type=int, default=d.port)
+    p.add_argument("--memory_mb", type=int, default=d.memory_mb,
+                   help="HBM budget for device arenas in MB (0 = unlimited); "
+                        "cold arenas LRU-evict to the host store")
     p.add_argument("--bind", default=d.bind)
     p.add_argument("--sync", dest="sync_writes", action="store_true",
                    default=d.sync_writes)
@@ -163,6 +166,7 @@ def main(argv=None) -> int:
         tls_key=opts.tls_key,
         cluster=cluster,
         profiler=profiler,
+        arena_budget_mb=opts.memory_mb,
     )
     srv.start()
     print(f"dgraph-tpu serving at {srv.addr}  (dashboard at /, queries at /query)")

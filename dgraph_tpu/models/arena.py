@@ -158,6 +158,18 @@ class CSRArena:
         C = ops.CHUNK
         return (self.degree_of_rows(rows) + C - 1) // C
 
+    def device_bytes(self) -> int:
+        """HBM footprint of this arena's device tensors (incl. built lazy
+        layouts) — the residency manager's accounting unit."""
+        n = 0
+        for t in (self.src, self.offsets, self.dst, self._lut):
+            if t is not None:
+                n += t.size * t.dtype.itemsize
+        for pair in (self._chunked, self._inline, self._inline_grouped):
+            if pair is not None:
+                n += sum(t.size * t.dtype.itemsize for t in pair)
+        return n
+
     _inline: Optional[tuple] = None  # lazy (metap, ov_chunks)
 
     def inline_layout(self) -> tuple:
@@ -482,6 +494,9 @@ class IndexArena:
             return i
         return -1
 
+    def device_bytes(self) -> int:
+        return self.csr.device_bytes()
+
     def row_range(self, lo=None, hi=None, lo_open=False, hi_open=False) -> Tuple[int, int]:
         """Token rows t with lo <=(<) t <=(<) hi, as [start, end)."""
         start = 0
@@ -519,6 +534,11 @@ class ValueArena:
                                     # predicate — untagged host lookup and
                                     # this arena agree uid-for-uid
 
+    def device_bytes(self) -> int:
+        return sum(
+            t.size * t.dtype.itemsize for t in (self.src, self.vals, self.ranks)
+        )
+
 
 def _cache_locked(fn):
     """Run an ArenaManager accessor under its cache lock (see __init__)."""
@@ -543,7 +563,13 @@ class ArenaManager:
     stalls only readers of that same predicate.
     """
 
-    def __init__(self, store: PostingStore, mesh=None, shard_threshold: int = 4096):
+    def __init__(
+        self,
+        store: PostingStore,
+        mesh=None,
+        shard_threshold: int = 4096,
+        budget_bytes: Optional[int] = None,
+    ):
         self.store = store
         # device mesh for uid-range row sharding of big predicates (the
         # intra-predicate sharding the reference lacks, SURVEY.md §5);
@@ -569,6 +595,28 @@ class ArenaManager:
         # warm ones.  RLock because accessors nest (has_rows → data).
         self._cache_lock = threading.RLock()
         self._build_locks: Dict[tuple, threading.Lock] = {}
+        # HBM residency budget (bytes): the analog of the reference's
+        # memory-watermark-sized posting LRU (posting/lru.go:57,
+        # posting/lists.go:191).  0 = unlimited.  Cold arenas evict
+        # WHOLLY from the cache (host store keeps the truth; the next
+        # access rebuilds), touched arenas move to the LRU tail.
+        from collections import OrderedDict as _OD
+
+        self.budget_bytes = int(
+            budget_bytes
+            if budget_bytes is not None
+            else _os.environ.get("DGRAPH_TPU_ARENA_BUDGET", 0)
+        )
+        self._lru: "_OD[tuple, int]" = _OD()  # (cache id, key) -> bytes
+        self._lru_total = 0  # running sum of _lru values (O(1) touches)
+        self._caches_by_id = {
+            id(self._data): self._data,
+            id(self._reverse): self._reverse,
+            id(self._index): self._index,
+            id(self._values): self._values,
+            id(self._sharded): self._sharded,
+        }
+        self.evictions = 0
 
     def _get_or_build(self, cache, key, build, valid=None):
         """cache[key], building OUTSIDE the cache lock under a per-key
@@ -582,21 +630,72 @@ class ArenaManager:
         with self._cache_lock:
             a = cache.get(key)
             if a is not None and (valid is None or valid(a)):
+                self._touch(lkey, a)
                 return a
             bl = self._build_locks.setdefault(lkey, threading.Lock())
         with bl:
             with self._cache_lock:
                 a = cache.get(key)
                 if a is not None and (valid is None or valid(a)):
+                    self._touch(lkey, a)
                     return a
             try:
                 a = build()
                 with self._cache_lock:
                     cache[key] = a
+                    self._touch(lkey, a)
+                    self._evict_over_budget(protect=lkey)
             finally:
                 with self._cache_lock:
                     self._build_locks.pop(lkey, None)
             return a
+
+    def _touch(self, lkey: tuple, obj) -> None:
+        """LRU bookkeeping under _cache_lock: refresh recency + size (lazy
+        device layouts — lut/chunked/inline — built after caching grow the
+        footprint, so warm touches also re-check the budget)."""
+        if lkey[0] == id(self._sharded):
+            obj = obj[1]  # (_sharded caches (source arena, ShardedArena))
+        db = getattr(obj, "device_bytes", None)
+        if db is None:
+            return
+        new = db()
+        self._lru_total += new - self._lru.get(lkey, 0)
+        self._lru[lkey] = new
+        self._lru.move_to_end(lkey)
+        self._evict_over_budget(protect=lkey)
+
+    def _lru_drop(self, cache, key) -> None:
+        """Remove a cache entry's budget accounting (refresh invalidation
+        path) — phantom bytes would otherwise shrink the budget forever."""
+        b = self._lru.pop((id(cache), key), None)
+        if b is not None:
+            self._lru_total -= b
+
+    def _evict_over_budget(self, protect: tuple) -> None:
+        """Drop least-recently-used arenas until within budget (never the
+        entry just touched).  Evicting a data/reverse arena also drops its
+        mesh-sharded view — the view holds a reference that would pin the
+        arena's HBM alive.  Concurrent readers holding a popped arena keep
+        using their reference safely — the object only leaves the cache,
+        and the momentary overshoot ends with their request."""
+        if not self.budget_bytes:
+            return
+        while self._lru_total > self.budget_bytes and len(self._lru) > 1:
+            victim, vbytes = next(iter(self._lru.items()))
+            if victim == protect:
+                break
+            self._lru.pop(victim)
+            self._lru_total -= vbytes
+            cache = self._caches_by_id.get(victim[0])
+            if cache is not None:
+                cache.pop(victim[1], None)
+            if cache is self._data or cache is self._reverse:
+                skey = (victim[1], cache is self._reverse)
+                if skey in self._sharded:
+                    self._sharded.pop(skey, None)
+                    self._lru_drop(self._sharded, skey)
+            self.evictions += 1
 
     @_cache_locked
     def refresh(self):
@@ -620,6 +719,8 @@ class ArenaManager:
             self._values.clear()
             self._index.clear()
             self._sharded.clear()
+            self._lru.clear()
+            self._lru_total = 0
             dirty.discard("*")
             # remaining per-predicate marks fall through to the loop:
             # their caches are already gone, so it just consumes deltas
@@ -631,12 +732,17 @@ class ArenaManager:
                 continue
             for key in [k for k in self._data if k == p or k.startswith(p + "\x00")]:
                 self._data.pop(key, None)
+                self._lru_drop(self._data, key)
             self._reverse.pop(p, None)
+            self._lru_drop(self._reverse, p)
             self._values.pop(p, None)
-            self._sharded.pop((p, False), None)
-            self._sharded.pop((p, True), None)
+            self._lru_drop(self._values, p)
+            for sk in ((p, False), (p, True)):
+                self._sharded.pop(sk, None)
+                self._lru_drop(self._sharded, sk)
             for key in [k for k in self._index if k[0] == p]:
                 self._index.pop(key, None)
+                self._lru_drop(self._index, key)
             dirty.discard(p)
 
     def _try_apply_delta(self, pred: str, delta: list) -> bool:

@@ -57,6 +57,11 @@ class ShardedArena:
     dst: jnp.ndarray      # [n_shards, Ep] local edges, SENT pad
     n_shards: int
 
+    def device_bytes(self) -> int:
+        return sum(
+            t.size * t.dtype.itemsize for t in (self.src, self.offsets, self.dst)
+        )
+
 
 def shard_arena_rows(h_src: np.ndarray, h_offsets: np.ndarray, h_dst: np.ndarray, n_shards: int) -> ShardedArena:
     """Split CSR rows into n contiguous uid-range shards (host-side)."""

@@ -71,7 +71,12 @@ class QueryEngine:
     shared immutable posting state)."""
 
     def __init__(
-        self, store: PostingStore, mesh=None, shard_threshold: int = 4096, arenas=None
+        self,
+        store: PostingStore,
+        mesh=None,
+        shard_threshold: int = 4096,
+        arenas=None,
+        arena_budget_bytes=None,
     ):
         self.store = store
         # ``arenas`` shares a warm ArenaManager between engine instances:
@@ -82,7 +87,12 @@ class QueryEngine:
         self.arenas = (
             arenas
             if arenas is not None
-            else ArenaManager(store, mesh=mesh, shard_threshold=shard_threshold)
+            else ArenaManager(
+                store,
+                mesh=mesh,
+                shard_threshold=shard_threshold,
+                budget_bytes=arena_budget_bytes,
+            )
         )
         from dgraph_tpu.query.chain import CHAIN_THRESHOLD
 

@@ -56,10 +56,15 @@ class DgraphServer:
         tls_key: str = "",
         cluster=None,
         profiler=None,
+        arena_budget_mb: int = 0,
     ):
         self.cluster = cluster  # ClusterService when clustered, else None
         self.store = store
-        self.engine = QueryEngine(store, mesh=_auto_mesh())
+        self.engine = QueryEngine(
+            store,
+            mesh=_auto_mesh(),
+            arena_budget_bytes=(arena_budget_mb * (1 << 20)) or None,
+        )
         self.health = HealthGate()
         self.tracer = Tracer(trace_ratio)
         self.export_path = export_path

@@ -53,6 +53,11 @@ class Options:
     num_pending: int = 1000
     max_edges: int = 1_000_000
 
+    # HBM residency budget for device arenas, in MB; 0 = unlimited.  The
+    # memory-watermark sizing of the reference's posting LRU
+    # (posting/lists.go:191 --memory_mb, posting/lru.go:57).
+    memory_mb: int = 0
+
     def merged_with_yaml(self, path: str) -> "Options":
         """Overlay keys from a simple `key: value` YAML file onto self.
         Callers wanting flags-beat-YAML precedence (the reference applies
