@@ -10,9 +10,19 @@ expansion via ops.expand_chunked, dedup via sort), and only the final
 per-level result matrices transfer to the host for filtering-free levels'
 JSON encoding.
 
-Eligibility (per level): plain uid expansion — no count, no filter, no
-facets, no order/pagination, no groupby, no var-func — i.e. the shape of
-the reference's hot film queries (wiki/content/performance/index.md:32).
+Eligibility (per level): uid expansion without count/facets/groupby/
+var-funcs.  Round 4 extends fusion to the two most common decorations of
+the reference's hot film queries (wiki/content/performance/index.md:32):
+
+- **@filter** whose tree resolves WITHOUT the frontier (index funcs,
+  uid literals, boolean combinations — not val()/count()/uid_in): the
+  keep-set resolves once on the host, rides to the device, and applies
+  as one member_mask inside the fused program.
+- **orderasc/orderdesc + first/offset** on a ValueArena-backed attribute
+  (numeric/datetime, no @lang, no var): per-parent segmented rank sort +
+  windowing run inside the program (ops/order.py kernels), so "top-N by
+  date per parent" truncates the device-resident frontier directly.
+
 Anything else falls back to the per-level path, which remains the
 general-correctness implementation.
 
@@ -52,18 +62,66 @@ CHAIN_MAX_CAPC_LIGHT = int(
 )
 
 
+def _filter_fusable(ft) -> bool:
+    """Can this filter tree resolve to a uid keep-set WITHOUT the
+    frontier?  val()/count()/uid_in leaves depend on per-candidate state;
+    everything else (index funcs, has, regexp, geo, uid literals, and/or/
+    not combinations) resolves globally once."""
+    if ft.func is not None:
+        f = ft.func
+        return not (
+            f.is_val_var
+            or f.is_count
+            or f.needs_vars
+            # uid_in inspects each candidate's edges; checkpwd verifies
+            # per-candidate values — both are frontier-dependent
+            or f.name in ("uid_in", "checkpwd")
+        )
+    if ft.op == "not":
+        # complementing needs the candidate universe (the engine's normal
+        # path complements against the level's dest set)
+        return False
+    return all(_filter_fusable(c) for c in ft.children)
+
+
+def _order_fusable(engine, sg) -> bool:
+    """Per-parent order (+ first/offset windowing) fuses under EXACTLY the
+    engine device-order preconditions (_device_order_perm): rank-sortable
+    type, lang-less value arena, not a var; negative ``first`` ("last N")
+    stays on the host path."""
+    p = sg.params
+    if p.after:
+        return False  # 'after' interleaves with ordering; host path owns it
+    if (p.first or 0) < 0 or (p.offset or 0) < 0:
+        return False  # negative window = take-from-tail, host semantics
+    if not (p.order_attr or p.first or p.offset):
+        return True  # nothing to do
+    if not p.order_attr:
+        return True  # pure windowing in matrix order
+    if p.order_is_var or p.order_langs:
+        return False
+    tid = engine.store.schema.type_of(p.order_attr)
+    if tid not in type(engine)._DEVICE_ORDER_TIDS:
+        return False
+    va = engine.arenas.values(p.order_attr)
+    return va.langless and va.n > 0
+
+
 def eligible_level(engine, sg) -> bool:
-    """Is this SubGraph a fusable plain uid expansion?"""
+    """Is this SubGraph a fusable uid expansion (plain, filtered and/or
+    ordered — see module docstring)?"""
     p = sg.params
     if sg.attr in ("", "_uid_", "uid", "val", "math", "_predicate_"):
         return False
-    if sg.func is not None or sg.filter is not None:
+    if sg.func is not None:
+        return False
+    if sg.filter is not None and not _filter_fusable(sg.filter):
         return False
     if p.do_count or p.is_groupby or p.expand:
         return False
     if p.facets is not None or p.facets_filter is not None:
         return False
-    if p.order_attr or p.first or p.offset or p.after:
+    if not _order_fusable(engine, sg):
         return False
     tid = engine.store.schema.type_of(sg.attr)
     from dgraph_tpu.models.types import TypeID
@@ -87,27 +145,37 @@ def collect_chain(engine, child) -> List:
 
 
 @partial(jax.jit, static_argnames=("caps", "light"))
-def _run_fused(root_vec, metas, cdsts, luts, caps, light=False):
+def _run_fused(root_vec, metas, cdsts, luts, keeps, orders, caps, light=False):
     """One program for the whole chain, ONE packed output buffer.
 
     root_vec: int32[cap_u0] sorted-unique uids, SENT-padded.
     metas/cdsts/luts: tuples of per-level arena arrays.
-    caps: static tuple of (capc_i, cap_u_i) per level; cap_u_i bounds the
-      deduped frontier fed to level i+1.
+    keeps: per level, a sorted-unique-padded keep-set (fused @filter) or
+      None — applied as one member_mask over the level's output.
+    orders: per level, None or (val_src, val_ranks, desc, offset, first):
+      per-parent segmented rank sort + windowing (worker/sort.go:263's
+      processSort, run inside the program).
+    caps: static tuple of (capc_i, cap_u_i, need_dest_i, decorated_i,
+      order_static_i) where order_static_i is None or the static window
+      spec (desc, offset, first, has_vals); cap_u_i bounds the deduped
+      frontier fed to level i+1; decorated levels emit a FLAT
+      (slot-aligned) matrix + per-slot owners instead of the chunked
+      matrix + per-chunk seg.
     light: var-block mode — no result matrices needed (nothing will be
       JSON-encoded), so per level only the edge count and, where a var or
-      sibling subtree consumes it on the host (caps[i][2]), the deduped
+      sibling subtree consumes it on the host (need_dest), the deduped
       frontier transfer: 10-100× less traffic on big fan-outs.
 
-    Everything returns as a single concatenated int32 vector (layout per
-    level: [out2d.ravel | seg | nxt] | [nxt if needed] | total) — each
+    Everything returns as a single concatenated int32 vector — each
     device→host fetch pays the transport round trip separately, so the
     whole chain transfers once.
     """
+    from dgraph_tpu.ops.order import gather_ranks, segmented_sort_perm
+
     u = root_vec
     parts = []
     for i in range(len(metas)):
-        capc, cap_u, need_dest = caps[i]
+        capc, cap_u, need_dest, decorated, order_static = caps[i]
         lut = luts[i]
         rows = jnp.where(
             (u >= 0) & (u < lut.shape[0]) & (u != SENT),
@@ -115,20 +183,64 @@ def _run_fused(root_vec, metas, cdsts, luts, caps, light=False):
             -1,
         )
         out2d, total, seg = ops.expand_chunked(
-            metas[i], cdsts[i], rows, capc, with_seg=not light
+            metas[i], cdsts[i], rows, capc, with_seg=(not light) or decorated
         )
-        nxt = ops.sort_unique(out2d.reshape(-1))[:cap_u]
-        if not light:
-            parts += [out2d.reshape(-1), seg, nxt, total.reshape(1)]
-        elif need_dest:
-            parts += [nxt, total.reshape(1)]
+        if decorated:
+            flat = out2d.reshape(-1)
+            segf = jnp.repeat(seg, ops.CHUNK)
+            segf = jnp.where(flat == SENT, -1, segf)
+            if keeps[i] is not None:
+                keep = ops.member_mask(flat, keeps[i])
+                flat = jnp.where(keep, flat, SENT)
+                segf = jnp.where(keep, segf, -1)
+            if order_static is not None:
+                desc, off, first, has_vals = order_static
+                if has_vals:
+                    vsrc, vranks = orders[i]
+                    ranks = gather_ranks(vsrc, vranks, flat)
+                    perm = segmented_sort_perm(segf, ranks, desc)
+                else:
+                    # pure windowing: keep matrix order, just group by
+                    # parent (stable sort on segment only)
+                    perm = segmented_sort_perm(
+                        segf, jnp.zeros_like(flat), False
+                    )
+                flat = flat[perm]
+                segf = segf[perm]
+                # per-parent window: position within the (now contiguous)
+                # segment = iota - running segment start
+                n = flat.shape[0]
+                iota = jnp.arange(n, dtype=jnp.int32)
+                is_first = jnp.concatenate(
+                    [jnp.ones((1,), bool), segf[1:] != segf[:-1]]
+                )
+                start = jax.lax.cummax(jnp.where(is_first, iota, 0))
+                pos = iota - start
+                w = (segf >= 0) & (pos >= off)
+                if first:
+                    w &= pos < off + first
+                flat = jnp.where(w, flat, SENT)
+                segf = jnp.where(w, segf, -1)
+            nxt = ops.sort_unique(flat)[:cap_u]
+            if not light:
+                parts += [flat, segf, nxt, total.reshape(1)]
+            elif need_dest:
+                parts += [nxt, total.reshape(1)]
+            else:
+                parts += [total.reshape(1)]
         else:
-            parts += [total.reshape(1)]
+            nxt = ops.sort_unique(out2d.reshape(-1))[:cap_u]
+            if not light:
+                parts += [out2d.reshape(-1), seg, nxt, total.reshape(1)]
+            elif need_dest:
+                parts += [nxt, total.reshape(1)]
+            else:
+                parts += [total.reshape(1)]
         u = nxt
     return jnp.concatenate(parts)
 
 
-def try_run_chain(engine, child, src: np.ndarray) -> bool:
+def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
     """Attempt fused execution of the chain rooted at ``child`` with
     frontier ``src``.  On success, stages (out_flat, seg_ptr) on every
     chain level (chain_stash) and returns True; on ineligibility returns
@@ -183,7 +295,43 @@ def try_run_chain(engine, child, src: np.ndarray) -> bool:
         and not any(sg.params.cascade for sg in levels)
     )
     max_capc = CHAIN_MAX_CAPC_LIGHT if light else CHAIN_MAX_CAPC
-    caps: List[Tuple[int, int, bool]] = []
+    # pre-resolve fused filters to keep-sets + order specs (host, once).
+    # Resolution happens only after the fan-out threshold check above, so
+    # small queries never pay it.
+    from dgraph_tpu.query.functions import QueryError
+
+    keeps: List = []
+    orders: List = []
+    order_statics: List = []
+    for sg in levels:
+        keep = None
+        if sg.filter is not None:
+            if resolver is None:
+                return False
+            try:
+                kset = _resolve_filter_global(engine, sg.filter, resolver)
+            except QueryError:
+                return False
+            keep = jnp.asarray(
+                ops.pad_to(np.asarray(kset), ops.bucket(max(1, len(kset))))
+            )
+        keeps.append(keep)
+        p = sg.params
+        if p.order_attr or p.first or p.offset:
+            has_vals = bool(p.order_attr)
+            order_statics.append(
+                (bool(p.order_desc), int(p.offset or 0), int(p.first or 0), has_vals)
+            )
+            if has_vals:
+                va = engine.arenas.values(p.order_attr)
+                orders.append((va.src, va.ranks))
+            else:
+                orders.append(None)
+        else:
+            order_statics.append(None)
+            orders.append(None)
+
+    caps: List[Tuple[int, int, bool, bool, Optional[tuple]]] = []
     m = len(src)  # bound on the unique frontier entering each level
     for i, a in enumerate(arenas):
         if i == 0:
@@ -206,7 +354,8 @@ def try_run_chain(engine, child, src: np.ndarray) -> bool:
             or len(sg.children) > 1
             or i == len(levels) - 1
         )
-        caps.append((capc, cap_u, need_dest))
+        decorated = keeps[i] is not None or order_statics[i] is not None
+        caps.append((capc, cap_u, need_dest, decorated, order_statics[i]))
         m = min(capc * ops.CHUNK, nd)
 
     metas, cdsts, luts = [], [], []
@@ -219,7 +368,8 @@ def try_run_chain(engine, child, src: np.ndarray) -> bool:
     root_vec = jnp.asarray(ops.pad_to(src, ops.bucket(max(1, len(src)))))
     packed = np.asarray(  # ONE device round trip for the whole chain
         _run_fused(
-            root_vec, tuple(metas), tuple(cdsts), tuple(luts), tuple(caps),
+            root_vec, tuple(metas), tuple(cdsts), tuple(luts),
+            tuple(keeps), tuple(orders), tuple(caps),
             light=light,
         )
     )
@@ -227,7 +377,11 @@ def try_run_chain(engine, child, src: np.ndarray) -> bool:
     # --- host conversion: packed buffer → engine results per level ---
     src_list = np.asarray(src, dtype=np.int64)
     pos = 0
-    for sg, (capc, cap_u, need_dest) in zip(levels, caps):
+    for sg, (capc, cap_u, need_dest, decorated, _ostat) in zip(levels, caps):
+        # the fused program already applied these; the engine must not
+        # re-apply them to the stashed matrices
+        sg.chain_filtered = decorated and sg.filter is not None
+        sg.chain_ordered = decorated and _ostat is not None
         if light:
             dest = None
             if need_dest:
@@ -243,22 +397,55 @@ def try_run_chain(engine, child, src: np.ndarray) -> bool:
             continue
         flat = packed[pos : pos + capc * ops.CHUNK]
         pos += capc * ops.CHUNK
-        seg = packed[pos : pos + capc]
-        pos += capc
+        if decorated:
+            owner = packed[pos : pos + capc * ops.CHUNK]  # per-slot owners
+            pos += capc * ops.CHUNK
+        else:
+            seg = packed[pos : pos + capc]
+            pos += capc
+            owner = np.repeat(seg, ops.CHUNK)
         nxt = packed[pos : pos + cap_u]
         pos += cap_u
         pos += 1  # total (unused in full mode: lengths say it)
-        owner = np.repeat(seg, ops.CHUNK)
         valid = flat != SENT
         out_flat = flat[valid].astype(np.int64)
         owner = owner[valid]
         n_src = len(src_list)
         counts = np.bincount(owner, minlength=n_src)[:n_src]
+        if decorated:
+            # per-parent order survives, but slots of one parent may be
+            # interleaved with SENT gaps: regroup stably by owner
+            grp = np.argsort(owner, kind="stable")
+            out_flat = out_flat[grp]
         seg_ptr = np.zeros(n_src + 1, dtype=np.int64)
         np.cumsum(counts, out=seg_ptr[1:])
         sg.chain_stash = ("full", out_flat, seg_ptr, src_list)
         src_list = nxt[nxt != SENT].astype(np.int64)
     return True
+
+
+def _resolve_filter_global(engine, ft, resolver) -> np.ndarray:
+    """Resolve a fused filter tree to ONE sorted uid keep-set without the
+    frontier (leaves and ops pre-checked by _filter_fusable; 'not' is
+    excluded there — it needs the candidate universe)."""
+    if ft.func is not None:
+        return np.asarray(resolver.resolve(ft.func, None), dtype=np.int64)
+    if ft.op == "and":
+        out = None
+        for c in ft.children:
+            s = _resolve_filter_global(engine, c, resolver)
+            out = s if out is None else np.intersect1d(out, s)
+        return out if out is not None else np.empty(0, np.int64)
+    if ft.op == "or":
+        parts = [_resolve_filter_global(engine, c, resolver) for c in ft.children]
+        out = parts[0]
+        for s in parts[1:]:
+            out = np.union1d(out, s)
+        return out
+    # 'not' cannot complement without a universe; signal ineligible
+    from dgraph_tpu.query.functions import QueryError
+
+    raise QueryError("not-filter is not chain-fusable")
 
 
 def _topm_chunk_sum(arena, m: int) -> int:

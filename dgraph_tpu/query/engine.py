@@ -432,7 +432,7 @@ class QueryEngine:
             from dgraph_tpu.query.chain import try_run_chain
 
             t0 = _time.perf_counter()
-            try_run_chain(self, child, src)
+            try_run_chain(self, child, src, resolver)
             # failed attempts count too: planning cost must show up in
             # SOME bucket or the breakdown misleads
             self.stats["chain_ms"] += (_time.perf_counter() - t0) * 1e3
@@ -451,10 +451,16 @@ class QueryEngine:
                 self.stats["chain_fused_levels"] += 1
                 self._exec_children(child, resolver, uid_vars, value_vars)
                 return
+            # misaligned light stash: the per-level re-expansion below
+            # must re-apply filter/order — the fused flags are stale
+            child.chain_filtered = False
+            child.chain_ordered = False
         if child.chain_stash is not None:
             _tag, out_flat, seg_ptr, stash_src = child.chain_stash
             child.chain_stash = None
             if len(stash_src) != len(src):  # defensive: never mis-align
+                child.chain_filtered = False
+                child.chain_ordered = False
                 arena = (
                     self.arenas.reverse(attr) if child.reverse else self.arenas.data(attr)
                 )
@@ -472,13 +478,14 @@ class QueryEngine:
         child.seg_ptr = seg_ptr
         dest = np.unique(out_flat)
 
-        if child.filter is not None:
+        if child.filter is not None and not getattr(child, "chain_filtered", False):
             dest = self._apply_filter(child.filter, dest, resolver)
             self._mask_matrix(child, dest)
         self._load_edge_facets(child)
         if child.params.facets_filter is not None:
             self._apply_facet_filter(child)
-        self._order_and_paginate_child(child, value_vars)
+        if not getattr(child, "chain_ordered", False):
+            self._order_and_paginate_child(child, value_vars)
         child.dest_uids = np.unique(child.out_flat)
 
         if p.is_groupby:

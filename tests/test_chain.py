@@ -90,8 +90,8 @@ def test_chain_actually_fuses():
     calls = []
     orig = chain_mod.try_run_chain
 
-    def spy(engine, child, src):
-        r = orig(engine, child, src)
+    def spy(engine, child, src, resolver=None):
+        r = orig(engine, child, src, resolver)
         calls.append((child.attr, r))
         return r
 
@@ -146,3 +146,94 @@ def test_light_mode_keeps_rowless_leaf_uids():
     got = sorted(int(x["_uid_"], 16) for x in out["r"])
     want = sorted({0x1000 + m * 8 + l for m in range(2, 10) for l in range(4)})
     assert got == want
+
+
+def _film_engine(threshold, n_dirs=4, films_per=80):
+    """Star-shaped film graph big enough to clear the chain threshold."""
+    from dgraph_tpu.models import PostingStore
+    from dgraph_tpu.query.engine import QueryEngine
+
+    store = PostingStore()
+    eng = QueryEngine(store)
+    eng.run("mutation { schema { tag: string @index(term) . year: int . } }")
+    lines = []
+    uid = 1000
+    for d in range(1, n_dirs + 1):
+        for f in range(films_per):
+            uid += 1
+            lines.append(f"<0x{d:x}> <film> <0x{uid:x}> .")
+            lines.append(f'<0x{uid:x}> <year> "{1980 + (uid % 40)}"^^<xs:int> .')
+            if uid % 2 == 0:
+                lines.append(f'<0x{uid:x}> <tag> "good" .')
+            for a in range(3):
+                lines.append(f"<0x{uid:x}> <starring> <0x{uid * 10 + a:x}> .")
+    eng.run("mutation { set { %s } }" % "\n".join(lines))
+    eng.chain_threshold = threshold
+    return eng
+
+
+def test_chain_fuses_filtered_and_ordered_levels(monkeypatch):
+    """Round-4 chain extension: a filtered + ordered/windowed level fuses
+    into the single device program (no per-level fallback), and results
+    match the per-level reference path exactly — order included."""
+    from dgraph_tpu.query import chain as chain_mod
+
+    q = """{
+      d(func: uid(1, 2, 3, 4)) {
+        film (orderdesc: year, first: 5) @filter(anyofterms(tag, "good")) {
+          starring { _uid_ }
+        }
+      }
+    }"""
+
+    # reference result: per-level path (chains disabled)
+    want = _film_engine(1 << 60).run(q)
+
+    # fused result: force chains on, assert the decorated level fused
+    eng = _film_engine(1)
+    calls = []
+    orig = chain_mod.try_run_chain
+
+    def spy(engine, child, src, resolver=None):
+        r = orig(engine, child, src, resolver)
+        calls.append((child.attr, r))
+        return r
+
+    monkeypatch.setattr(chain_mod, "try_run_chain", spy)
+    got = eng.run(q)
+    assert got == want
+    assert eng.stats["chain_fused_levels"] >= 2, (calls, eng.stats)
+    assert ("film", True) in calls
+
+
+def test_chain_not_filter_falls_back_correctly():
+    """not-filters stay on the general path (ineligible for fusion) and
+    still produce correct results."""
+    q = """{
+      d(func: uid(1, 2)) {
+        film @filter(not anyofterms(tag, "good")) {
+          _uid_
+        }
+      }
+    }"""
+    assert _film_engine(1).run(q) == _film_engine(1 << 60).run(q)
+
+
+def test_chain_filter_only_and_window_only_levels():
+    """Filter-without-order and window-without-order both fuse and match."""
+    for q in (
+        '{ d(func: uid(1, 2, 3, 4)) { film @filter(anyofterms(tag, "good")) '
+        "{ starring { _uid_ } } } }",
+        "{ d(func: uid(1, 2, 3, 4)) { film (first: 7, offset: 2) "
+        "{ starring { _uid_ } } } }",
+        '{ d(func: uid(1, 2, 3, 4)) { film (orderasc: year) '
+        "{ starring { _uid_ } } } }",
+    ):
+        assert _film_engine(1).run(q) == _film_engine(1 << 60).run(q), q
+
+
+def test_chain_negative_first_falls_back():
+    """first: -N means 'last N' (host semantics) — must NOT fuse, must
+    still match the reference path."""
+    q = "{ d(func: uid(1, 2)) { film (orderasc: year, first: -3) { _uid_ } } }"
+    assert _film_engine(1).run(q) == _film_engine(1 << 60).run(q)
