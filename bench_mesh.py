@@ -14,16 +14,22 @@ Usage: python bench_mesh.py   (env: BM_EDGES, default 21_000_000)
 
 import os
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-).strip()
+# BM_PLATFORM=tpu runs on real hardware (a pod slice exposes its chips as
+# the mesh; the ICI crossover curve in PARITY.md comes from that mode);
+# default is the 8-device virtual CPU mesh for structure validation
+_REAL = os.environ.get("BM_PLATFORM", "cpu") != "cpu"
+if not _REAL:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 import json
 import time
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not _REAL:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 
@@ -84,6 +90,36 @@ def main():
     local_s = time.time() - t0
 
     assert edges == edges_l, (edges, edges_l)
+
+    # crossover sweep: where does sharded beat local as expansion size
+    # grows?  One point per frontier size (VERDICT r3 weak #5 asked for a
+    # curve, not an anecdote).  On the virtual CPU mesh this exercises
+    # structure; the ICI curve comes from running the same sweep on a pod.
+    curve = []
+    for n_seed in (256, 1024, 4096, 16384, 65536):
+        fs = [np.unique(rng.integers(1, n_nodes + 1, size=n_seed)) for _ in range(3)]
+        capn = ops.bucket(max(
+            int(a.degree_of_rows(a.rows_for_uids_host(f)).sum()) for f in fs
+        ))
+        sharded_expand_segments(mesh, sa, fs[0], capn)  # warm
+        t0 = time.time()
+        for f in fs:
+            sharded_expand_segments(mesh, sa, f, capn)
+        sh_ms = (time.time() - t0) / len(fs) * 1e3
+        rows = ops.pad_rows(a.rows_for_uids_host(fs[0]), ops.bucket(len(fs[0])))
+        np.asarray(ops.expand_csr(a.offsets, a.dst, rows, capn)[0])  # warm
+        t0 = time.time()
+        for f in fs:
+            rows = ops.pad_rows(a.rows_for_uids_host(f), ops.bucket(len(f)))
+            out, seg, _t = ops.expand_csr(a.offsets, a.dst, rows, capn)
+            np.asarray(seg)
+        lo_ms = (time.time() - t0) / len(fs) * 1e3
+        curve.append({
+            "seeds": n_seed, "cap": capn,
+            "sharded_ms": round(sh_ms, 1), "local_ms": round(lo_ms, 1),
+            "ratio_local_over_sharded": round(lo_ms / sh_ms, 2),
+        })
+
     print(json.dumps({
         "metric": "mesh_sharded_vs_local_expand",
         "edges_per_query": edges // len(frontiers),
@@ -91,9 +127,10 @@ def main():
         "local_ms": round(local_s / len(frontiers) * 1e3, 1),
         "ratio_local_over_sharded": round(local_s / sharded_s, 2),
         "n_devices": 8,
-        "platform": "cpu-virtual-mesh",
+        "platform": jax.devices()[0].platform + ("-mesh" if _REAL else "-virtual-mesh"),
         "build_s": round(build_s, 1),
         "shard_s": round(shard_s, 1),
+        "crossover_curve": curve,
     }))
 
 
