@@ -57,18 +57,44 @@ def ensure_backend() -> str:
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
     last = ""
     for attempt in range(retries):
-        try:
-            r = subprocess.run(
+        # own process GROUP + file-backed output: the TPU plugin spawns
+        # tunnel helpers that inherit pipes — after a timeout kill of the
+        # probe alone, communicate() would block on the helper's copy of
+        # stdout forever (observed with a wedged chip).  killpg reaps the
+        # whole group and files can't block.
+        import tempfile
+
+        with tempfile.TemporaryFile("w+") as out, tempfile.TemporaryFile("w+") as err:
+            p = subprocess.Popen(
                 [sys.executable, "-c", _PROBE],
-                capture_output=True,
+                stdout=out,
+                stderr=err,
                 text=True,
-                timeout=probe_timeout,
+                start_new_session=True,
             )
-            if r.returncode == 0:
-                return r.stdout.strip().splitlines()[-1]
-            last = (r.stderr.strip().splitlines() or ["rc=%d" % r.returncode])[-1]
-        except subprocess.TimeoutExpired:
-            last = f"probe hung >{probe_timeout:.0f}s (backend wedged?)"
+            try:
+                rc = p.wait(timeout=probe_timeout)
+                out.seek(0)
+                err.seek(0)
+                if rc == 0:
+                    lines = out.read().strip().splitlines()
+                    if lines:
+                        return lines[-1]
+                    last = "probe printed nothing"
+                else:
+                    last = (err.read().strip().splitlines() or ["rc=%d" % rc])[-1]
+            except subprocess.TimeoutExpired:
+                import signal
+
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    p.kill()  # group signal denied: at least the child dies
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass  # unreaped zombie beats an unbounded hang
+                last = f"probe hung >{probe_timeout:.0f}s (backend wedged?)"
         if attempt < retries - 1:
             delay = 5 * (2**attempt)
             print(

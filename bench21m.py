@@ -16,17 +16,34 @@ import os
 import resource
 import time
 
-# Measurement config for the axon tunnel (~65ms RTT, ~44MB/s): the
-# per-level device path transfers full padded matrices, which this
-# transport loses to host numpy at every size — route per-level work to
-# the host and let the FUSED chains (one dispatch, frontier-only
-# transfers in light mode) carry the device story.  Co-located
-# deployments keep the 262144 default.
-os.environ.setdefault("DGRAPH_TPU_EXPAND_DEVICE_MIN", str(1 << 62))
+RESULTS = []
 
-from bench_engine import SCHEMA, build
-from dgraph_tpu.models import PostingStore
-from dgraph_tpu.query import QueryEngine
+
+def emit(d: dict) -> None:
+    """Record + print a metric, and REWRITE the results file after every
+    append — a crash mid-run must not lose hours of accumulated numbers
+    (the round-1 empty-artifact postmortem, bench.py docstring)."""
+    RESULTS.append(d)
+    print(json.dumps(d), flush=True)
+    out_path = os.environ.get("B21_OUT", "")
+    if out_path:
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"results": RESULTS, "rss_gb": round(rss_gb(), 2)}, f, indent=1)
+        os.replace(tmp, out_path)
+
+# B21_HOST_LEVELS=1 reproduces the round-3 tunnel configuration (route
+# per-level work to host numpy; only fused chains touch the device).
+# The DEFAULT now keeps the engine's standard device routing (262144) —
+# the device story is measured, not asserted (VERDICT r3 weak #2): the
+# big-fanout shape below runs BOTH ways and records the ratio.
+if os.environ.get("B21_HOST_LEVELS") == "1":
+    os.environ.setdefault("DGRAPH_TPU_EXPAND_DEVICE_MIN", str(1 << 62))
+
+# engine imports happen INSIDE main() after the backend probe: a module-
+# level import that materializes any device value would initialize the
+# wedged backend before the CPU fallback can run (the order.py _BIG bug
+# class); keeping them lazy makes the probe contract self-contained
 
 # expected quads per director with the zipf generator (measured mean:
 # ~88 — bounded-pareto film/perf counts undershoot the uniform 97)
@@ -38,6 +55,18 @@ def rss_gb() -> float:
 
 
 def main():
+    # same wedged-TPU robustness contract as bench.py: probe the backend
+    # in a subprocess with a timeout, fall back to CPU so the run still
+    # records real numbers
+    from bench import ensure_backend
+
+    platform = ensure_backend()
+    print(f"# backend: {platform}", flush=True)
+    global SCHEMA, build, PostingStore, QueryEngine
+    from bench_engine import SCHEMA, build
+    from dgraph_tpu.models import PostingStore
+    from dgraph_tpu.query import QueryEngine
+
     target = int(os.environ.get("B21_QUADS", 21_000_000))
     chunk_quads = int(os.environ.get("B21_CHUNK", 2_000_000))
     n_directors = target // QUADS_PER_DIRECTOR
@@ -71,14 +100,14 @@ def main():
             flush=True,
         )
 
-    print(json.dumps({
+    emit({
         "metric": "bulk_load_quads_per_sec",
         "value": round(total_quads / load_s, 1),
         "unit": "quads/s",
         "vs_baseline": round((total_quads / load_s) / 73_000, 3),
         "quads": total_quads,
         "rss_gb": round(rss_gb(), 2),
-    }), flush=True)
+    })
 
     # the two wiki shapes.  The 3-hop seeds a MID-TAIL actor — the wiki's
     # anchor is a typical entity; with the zipf corpus a head actor is a
@@ -102,14 +131,14 @@ def main():
         t0 = time.time()
         eng.run(hot_actor)
         times.append(time.time() - t0)
-    print(json.dumps({
+    emit({
         "metric": "engine21m_3hop_hot_actor",
         "value": round(min(times) * 1e3, 2),
         "unit": "ms",
         "edges": eng.stats["edges"],
         "fused_levels": eng.stats["chain_fused_levels"],
         "edges_per_sec": round(eng.stats["edges"] / min(times), 1),
-    }), flush=True)
+    })
     detail = """
     { dir(func: eq(name, "Director 11")) {
         name
@@ -141,15 +170,33 @@ def main():
     chain_s = min(times)
     edges = eng.stats["edges"]
     fused = eng.stats["chain_fused_levels"]
-    print(json.dumps({
+    # the SAME shape with the device paths disabled (chains off, per-level
+    # host numpy): the measured device-vs-host comparison the round-3
+    # bench only asserted
+    saved_thr = eng.chain_threshold
+    saved_min = eng.expand_device_min
+    eng.chain_threshold = 1 << 60
+    eng.expand_device_min = 1 << 62
+    eng.run(fanout)  # warm the host path
+    host_times = []
+    for _ in range(3):
+        t0 = time.time()
+        eng.run(fanout)
+        host_times.append(time.time() - t0)
+    host_s = min(host_times)
+    eng.chain_threshold = saved_thr
+    eng.expand_device_min = saved_min
+    emit({
         "metric": "engine21m_chain_fanout_edges_per_sec",
         "value": round(edges / chain_s, 1),
         "unit": "edges/s",
         "edges": edges,
         "fused_levels": fused,
         "ms": round(chain_s * 1e3, 1),
+        "host_ms": round(host_s * 1e3, 1),
+        "device_vs_host": round(host_s / chain_s, 2),
         "platform": jax.devices()[0].platform,
-    }), flush=True)
+    })
 
     baselines = {"3hop_coactor": 2.5, "4level_detail": 32.5}  # warm ms, i7
     for label, q in (("3hop_coactor", co_actor), ("4level_detail", detail)):
@@ -164,14 +211,16 @@ def main():
             times.append((time.time() - t0) * 1e3)
         times.sort()
         p50 = times[len(times) // 2]
-        print(json.dumps({
+        emit({
             "metric": f"engine21m_{label}_warm_p50",
             "value": round(p50, 2),
             "unit": "ms",
             "vs_baseline": round(baselines[label] / p50, 3),
             "cold_ms": round(cold_ms, 1),
-        }), flush=True)
+        })
     print(f"# final rss {rss_gb():.1f}GB", flush=True)
+    if os.environ.get("B21_OUT"):
+        print(f"# wrote {os.environ['B21_OUT']}", flush=True)
 
 
 def build_chunk(start_director: int, n_directors: int) -> str:

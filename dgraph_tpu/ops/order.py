@@ -25,8 +25,11 @@ import jax.numpy as jnp
 
 from dgraph_tpu.ops.sets import SENT
 
-# larger than any rank or segment index; used to push padding to the tail
-_BIG = jnp.int32(1 << 30)
+# larger than any rank or segment index; used to push padding to the tail.
+# A plain int, NOT jnp.int32(...): materializing a device scalar at import
+# initializes the JAX backend — with a wedged TPU that hangs EVERY import
+# of the engine (bench fallback paths included)
+_BIG = 1 << 30
 
 
 @jax.jit
