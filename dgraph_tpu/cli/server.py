@@ -10,6 +10,7 @@ health OK.
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 
@@ -34,6 +35,9 @@ def build_options(argv=None) -> Options:
                    help="(reserved) separate wal dir; DurableStore keeps wal beside postings")
     p.add_argument("--export", dest="export_path", default=d.export_path)
     p.add_argument("--port", type=int, default=d.port)
+    p.add_argument("--dumpsg", default=d.dumpsg,
+                   help="directory to dump each query's execution-shape "
+                        "tree as JSON (offline plan inspection)")
     p.add_argument("--memory_mb", type=int, default=d.memory_mb,
                    help="HBM budget for device arenas in MB (0 = unlimited); "
                         "cold arenas LRU-evict to the host store")
@@ -82,6 +86,15 @@ def build_options(argv=None) -> Options:
 
 
 def main(argv=None) -> int:
+    # honor JAX_PLATFORMS=cpu even though this image's sitecustomize
+    # imports jax at interpreter startup (consuming the env var before
+    # user code runs): config.update works any time before backend init.
+    # Without this a CPU-only deployment (or a wedged TPU) hangs in
+    # _auto_mesh's jax.devices() probe.
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     opts = build_options(argv)
     # profiling surface (setupProfiling, cmd/dgraph/main.go:181).  The
     # CPU profile covers QUERY EXECUTION (enabled per-request under the
@@ -167,6 +180,7 @@ def main(argv=None) -> int:
         cluster=cluster,
         profiler=profiler,
         arena_budget_mb=opts.memory_mb,
+        dumpsg_path=opts.dumpsg,
     )
     srv.start()
     print(f"dgraph-tpu serving at {srv.addr}  (dashboard at /, queries at /query)")

@@ -109,6 +109,12 @@ class QueryEngine:
         # counts + per-stage timings feed bench_engine and the debug
         # latency map
         self.stats = _fresh_stats()
+        # --dumpsg support: when the serving layer sets dump_shapes, each
+        # execute() stores the CHEAP execution-shape dicts (never the
+        # result-bearing SubGraph trees — those would pin whole result
+        # payloads on a long-lived engine) in last_dump, reset per request
+        self.dump_shapes = False
+        self.last_dump = None
 
     @property
     def expand_device_min(self) -> int:
@@ -129,6 +135,7 @@ class QueryEngine:
         """Execute an already-parsed request — the single request pipeline
         shared by the embedded path (run) and the HTTP server."""
         self.stats = _fresh_stats()
+        self.last_dump = None
         out: dict = {}
         if parsed.mutation is not None:
             from dgraph_tpu.serve.mutations import (
@@ -180,6 +187,10 @@ class QueryEngine:
             if not progressed:
                 raise QueryError("circular variable dependency between blocks")
 
+        if self.dump_shapes:
+            from dgraph_tpu.query.subgraph import dump_dict
+
+            self.last_dump = [dump_dict(sg) for sg in blocks]
         for sg in blocks:
             if sg.params.is_internal:
                 continue

@@ -89,6 +89,35 @@ class SubGraph:
 _UID_ATTRS = ("_uid_", "uid")
 
 
+def dump_dict(sg: "SubGraph") -> dict:
+    """Offline query-plan/result-shape inspection: the analog of the
+    reference's --dumpsg gob dumps (cmd/dgraph/main.go:347-358), as
+    JSON-able dicts.  Captures the execution SHAPE (attrs, params, edge
+    counts, frontier sizes, chain fusion flags) without the result
+    payload — what you diff when a plan regresses."""
+    p = sg.params
+    d = {
+        "attr": ("~" if sg.reverse else "") + (sg.attr or ""),
+        "alias": sg.alias or None,
+        "func": sg.func.name if sg.func is not None else None,
+        "filtered": sg.filter is not None,
+        "order": p.order_attr or None,
+        "first": p.first or None,
+        "offset": p.offset or None,
+        "n_src": int(len(sg.src_uids)) if sg.src_uids is not None else 0,
+        "n_edges": int(len(sg.out_flat)) if sg.out_flat is not None else 0,
+        "n_dest": int(len(sg.dest_uids)) if sg.dest_uids is not None else 0,
+        "chain_fused": bool(
+            getattr(sg, "chain_filtered", False)
+            or getattr(sg, "chain_ordered", False)
+        ),
+    }
+    kids = [dump_dict(c) for c in sg.children]
+    if kids:
+        d["children"] = kids
+    return {k: v for k, v in d.items() if v not in (None, False, 0) or k == "attr"}
+
+
 def build_subgraph(gq: GraphQuery) -> SubGraph:
     """AST → SubGraph (ToSubGraph:850 + params fill query.go:789-848)."""
     sg = SubGraph()

@@ -57,7 +57,11 @@ class DgraphServer:
         cluster=None,
         profiler=None,
         arena_budget_mb: int = 0,
+        dumpsg_path: str = "",
     ):
+        # --dumpsg analog (cmd/dgraph/main.go:347-358): write each query's
+        # execution-shape tree as timestamped JSON for offline inspection
+        self.dumpsg_path = dumpsg_path
         self.cluster = cluster  # ClusterService when clustered, else None
         self.store = store
         self.engine = QueryEngine(
@@ -200,6 +204,26 @@ class DgraphServer:
             PENDING_QUERIES.add(-1)
             self.tracer.finish(tr, "query", text[:120])
 
+    _dump_seq = __import__("itertools").count()
+
+    def _dump_subgraphs(self, dump) -> None:
+        import datetime as _dt
+
+        try:
+            import os as _os
+
+            _os.makedirs(self.dumpsg_path, exist_ok=True)
+            # timestamp + process-wide sequence: concurrent queries in the
+            # same microsecond must not overwrite each other's dump
+            name = "%s.%06d.json" % (
+                _dt.datetime.now().strftime("%Y%m%d.%H%M%S.%f"),
+                next(self._dump_seq),
+            )
+            with open(_os.path.join(self.dumpsg_path, name), "w") as f:
+                json.dump(dump, f, indent=1)
+        except OSError:  # dump failures must never fail the query
+            pass
+
     def _run_locked(self, parsed, out: dict) -> dict:
         # Mutations (and the profiler, which is not thread-safe) need the
         # exclusive side; pure queries share the read side and execute
@@ -219,7 +243,10 @@ class DgraphServer:
                 else:
                     eng = QueryEngine(self.store, arenas=self.engine.arenas)
                     eng.chain_threshold = self.engine.chain_threshold
+                eng.dump_shapes = bool(self.dumpsg_path)
                 out.update(eng.run_parsed(parsed))
+                if self.dumpsg_path and eng.last_dump:
+                    self._dump_subgraphs(eng.last_dump)
             finally:
                 if self._profiler is not None:
                     self._profiler.disable()

@@ -58,6 +58,10 @@ class Options:
     # (posting/lists.go:191 --memory_mb, posting/lru.go:57).
     memory_mb: int = 0
 
+    # directory for per-query execution-shape dumps (--dumpsg,
+    # cmd/dgraph/main.go:347); empty = disabled
+    dumpsg: str = ""
+
     def merged_with_yaml(self, path: str) -> "Options":
         """Overlay keys from a simple `key: value` YAML file onto self.
         Callers wanting flags-beat-YAML precedence (the reference applies

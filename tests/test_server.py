@@ -184,3 +184,43 @@ def test_tls_serving(tmp_path):
             assert b"Success" in resp.read()
     finally:
         srv.stop()
+
+
+def test_dumpsg_writes_execution_shape(tmp_path):
+    """--dumpsg analog (cmd/dgraph/main.go:347-358): each query drops a
+    JSON execution-shape tree for offline plan inspection."""
+    import os
+
+    server = DgraphServer(PostingStore(), dumpsg_path=str(tmp_path / "sg"))
+    server.start()
+    try:
+        _post(server.addr, "/query",
+              'mutation { set { <0x1> <name> "A" . <0x1> <follows> <0x2> . } }')
+        _post(server.addr, "/query", "{ q(func: uid(0x1)) { name follows { _uid_ } } }")
+        files = os.listdir(tmp_path / "sg")
+        assert files, "no dump written"
+        with open(tmp_path / "sg" / sorted(files)[-1]) as f:
+            dump = json.load(f)
+        root = dump[0]
+        assert root["n_dest"] == 1
+        attrs = {c["attr"] for c in root.get("children", [])}
+        assert "follows" in attrs and "name" in attrs
+    finally:
+        server.stop()
+
+
+def test_dumpsg_no_stale_plan_on_mutation_only(tmp_path):
+    """A mutation-only request must not re-dump the previous query's plan
+    (the shared write-path engine resets last_dump per request)."""
+    import os
+
+    server = DgraphServer(PostingStore(), dumpsg_path=str(tmp_path / "sg"))
+    server.start()
+    try:
+        _post(server.addr, "/query", 'mutation { set { <0x1> <name> "A" . } }')
+        _post(server.addr, "/query", "{ q(func: uid(0x1)) { name } }")
+        n_after_query = len(os.listdir(tmp_path / "sg"))
+        _post(server.addr, "/query", 'mutation { set { <0x2> <name> "B" . } }')
+        assert len(os.listdir(tmp_path / "sg")) == n_after_query
+    finally:
+        server.stop()
