@@ -355,8 +355,9 @@ class CSRArena:
         self._inline_grouped = None
         self._lut = None
         self._n_distinct_dst = None
-        if hasattr(self, "_topm_cdeg"):
-            del self._topm_cdeg
+        for attr in ("_topm_cdeg", "_topm_ovdeg"):
+            if hasattr(self, attr):
+                delattr(self, attr)
         self._device_stale = True
 
     def ensure_device(self) -> None:

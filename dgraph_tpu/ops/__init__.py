@@ -15,6 +15,7 @@ from dgraph_tpu.ops.sets import (  # noqa: F401
     bucket_fine,
     expand_chunked,
     expand_inline,
+    expand_inline_seg,
     expand_inline_grouped,
     skey_encode,
     skey_uid,

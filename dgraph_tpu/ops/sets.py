@@ -426,6 +426,42 @@ def skey_uid(v: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(v == SENT, SENT, v & GROUP_MASK)
 
 
+def _ov_slot_map(cs, cd, capc):
+    """Shared overflow slot→chunk construction (the scatter + prefix-sum
+    telescoping documented in expand_chunked): returns (chunkid[capc],
+    ok[capc], cstart, productive)."""
+    ccum = jnp.cumsum(cd)
+    cstart = ccum - cd
+    productive = cd > 0
+    end = jnp.where(productive, cs + cd, 0)
+    pe = jnp.concatenate([jnp.zeros((1,), end.dtype), jax.lax.cummax(end)[:-1]])
+    slot = jnp.where(productive, cstart, capc)
+    dvec = (
+        jnp.zeros((capc,), dtype=jnp.int32)
+        .at[slot]
+        .set(jnp.where(productive, cs - pe, 0).astype(jnp.int32), mode="drop")
+    )
+    i = jnp.arange(capc, dtype=jnp.int32)
+    chunkid = jnp.cumsum(dvec) + i
+    return chunkid, i < ccum[-1], cstart, productive
+
+
+def _ov_owner_map(cstart, productive, capc, nrows):
+    """Shared owner-per-chunk-slot construction (expand_chunked with_seg):
+    ordinal of the owning productive row by scatter+scan, mapped back to
+    its position in the row vector."""
+    slot = jnp.where(productive, cstart, capc)
+    ivec = jnp.zeros((capc,), dtype=jnp.int32).at[slot].set(1, mode="drop")
+    k = jnp.cumsum(ivec) - 1
+    k_row = jnp.cumsum(productive.astype(jnp.int32)) - 1
+    pos_of_ord = (
+        jnp.zeros((nrows,), dtype=jnp.int32)
+        .at[jnp.where(productive, k_row, nrows)]
+        .set(jnp.arange(nrows, dtype=jnp.int32), mode="drop")
+    )
+    return pos_of_ord[jnp.clip(k, 0, nrows - 1)]
+
+
 @partial(jax.jit, static_argnames=("capc", "pcap"))
 def expand_inline_grouped(
     metap: jnp.ndarray,
@@ -455,23 +491,41 @@ def expand_inline_grouped(
     vp = valid[:pcap]
     cs = jnp.where(vp, m[:pcap, 0], 0)
     cd = (jnp.maximum(jnp.where(vp, dg[:pcap], 0) - INLINE, 0) + 7) >> 3
-    ccum = jnp.cumsum(cd)
-    cstart = ccum - cd
-    productive = cd > 0
-    end = jnp.where(productive, cs + cd, 0)
-    pe = jnp.concatenate([jnp.zeros((1,), end.dtype), jax.lax.cummax(end)[:-1]])
-    slot = jnp.where(productive, cstart, capc)
-    dvec = (
-        jnp.zeros((capc,), dtype=jnp.int32)
-        .at[slot]
-        .set(jnp.where(productive, cs - pe, 0).astype(jnp.int32), mode="drop")
-    )
-    i = jnp.arange(capc, dtype=jnp.int32)
-    chunkid = jnp.cumsum(dvec) + i
-    ok = i < ccum[-1]
+    chunkid, ok, _cstart, _productive = _ov_slot_map(cs, cd, capc)
     ov = ov_chunks[jnp.clip(jnp.where(ok, chunkid, 0), 0, nc - 1)]
     ov = jnp.where(ok[:, None], ov, SENT)
     return inline, ov, total
+
+
+@partial(jax.jit, static_argnames=("capc",))
+def expand_inline_seg(
+    metap: jnp.ndarray,
+    ov_chunks: jnp.ndarray,
+    rows: jnp.ndarray,
+    capc: int,
+):
+    """expand_inline + per-overflow-chunk owner indices, for consumers
+    that must know which input row produced each slot (the fused chain's
+    uid-matrix reconstruction; inline slots' owner is their row position,
+    so only the overflow side needs a computed seg).
+
+    Returns (inline[B, INLINE], ov[capc, 8], total, ovseg[capc]) where
+    ovseg[j] = index into ``rows`` owning overflow chunk j, -1 on padding.
+    Rows: ascending-distinct over valid entries, -1 skips anywhere."""
+    nc = ov_chunks.shape[0]
+    nrows = rows.shape[0]
+    valid = rows >= 0
+    r = jnp.where(valid, rows, 0)
+    m = metap[r]
+    inline = jnp.where(valid[:, None], m[:, 2:], SENT)
+    cs = jnp.where(valid, m[:, 0], 0)
+    dg = jnp.where(valid, m[:, 1], 0)
+    cd = (jnp.maximum(dg - INLINE, 0) + 7) >> 3
+    chunkid, ok, cstart, productive = _ov_slot_map(cs, cd, capc)
+    ov = ov_chunks[jnp.clip(jnp.where(ok, chunkid, 0), 0, nc - 1)]
+    ov = jnp.where(ok[:, None], ov, SENT)
+    ovseg = _ov_owner_map(cstart, productive, capc, nrows)
+    return inline, ov, jnp.sum(dg).astype(jnp.int32), jnp.where(ok, ovseg, -1)
 
 
 def sort_desc_free(x: jnp.ndarray) -> jnp.ndarray:

@@ -145,50 +145,61 @@ def collect_chain(engine, child) -> List:
 
 
 @partial(jax.jit, static_argnames=("caps", "light"))
-def _run_fused(root_vec, metas, cdsts, luts, keeps, orders, caps, light=False):
+def _run_fused(root_vec, metas, ovs, luts, keeps, orders, caps, light=False):
     """One program for the whole chain, ONE packed output buffer.
 
-    root_vec: int32[cap_u0] sorted-unique uids, SENT-padded.
-    metas/cdsts/luts: tuples of per-level arena arrays.
+    Round 4: levels expand through the INLINE-HEAD layout
+    (ops.expand_inline_seg) — one 32B row gather serves metadata and the
+    first INLINE targets; only degree>INLINE rows touch overflow chunks.
+    Gather-index count per level roughly halves vs the chunked layout
+    (docs/ROOFLINE.md).
+
+    root_vec: int32[B0] sorted-unique uids, SENT-padded.
+    metas/ovs/luts: tuples of per-level inline-layout arrays.
     keeps: per level, a sorted-unique-padded keep-set (fused @filter) or
       None — applied as one member_mask over the level's output.
-    orders: per level, None or (val_src, val_ranks, desc, offset, first):
-      per-parent segmented rank sort + windowing (worker/sort.go:263's
-      processSort, run inside the program).
-    caps: static tuple of (capc_i, cap_u_i, need_dest_i, decorated_i,
-      order_static_i) where order_static_i is None or the static window
-      spec (desc, offset, first, has_vals); cap_u_i bounds the deduped
-      frontier fed to level i+1; decorated levels emit a FLAT
-      (slot-aligned) matrix + per-slot owners instead of the chunked
-      matrix + per-chunk seg.
-    light: var-block mode — no result matrices needed (nothing will be
-      JSON-encoded), so per level only the edge count and, where a var or
-      sibling subtree consumes it on the host (need_dest), the deduped
-      frontier transfer: 10-100× less traffic on big fan-outs.
+    orders: per level, None or (val_src, val_ranks) for the in-program
+      per-parent rank sort (static spec rides in caps).
+    caps: static tuple of (B_i, capc_i, cap_u_i, need_dest_i,
+      decorated_i, order_static_i): B_i = row-vector length, capc_i =
+      overflow-chunk capacity, cap_u_i bounds the deduped frontier fed to
+      level i+1; order_static_i = None | (desc, offset, first, has_vals).
+    light: var-block mode — only edge counts (and consumed frontiers)
+      transfer.
 
-    Everything returns as a single concatenated int32 vector — each
-    device→host fetch pays the transport round trip separately, so the
-    whole chain transfers once.
+    Packed layout per level:
+      full undecorated: [inline.ravel | ov.ravel | ovseg | nxt | total]
+      full decorated:   [flat | segf | nxt | total]   (slot-aligned)
+      light:            [nxt?] [total]
     """
     from dgraph_tpu.ops.order import gather_ranks, segmented_sort_perm
 
     u = root_vec
     parts = []
     for i in range(len(metas)):
-        capc, cap_u, need_dest, decorated, order_static = caps[i]
+        B, capc, cap_u, need_dest, decorated, order_static = caps[i]
         lut = luts[i]
         rows = jnp.where(
             (u >= 0) & (u < lut.shape[0]) & (u != SENT),
             lut[jnp.clip(u, 0, lut.shape[0] - 1)],
             -1,
         )
-        out2d, total, seg = ops.expand_chunked(
-            metas[i], cdsts[i], rows, capc, with_seg=(not light) or decorated
+        inline, ov, total, ovseg = ops.expand_inline_seg(
+            metas[i], ovs[i], rows, capc
         )
         if decorated:
-            flat = out2d.reshape(-1)
-            segf = jnp.repeat(seg, ops.CHUNK)
-            segf = jnp.where(flat == SENT, -1, segf)
+            # slot-aligned flat matrix + per-slot owners: inline slots'
+            # owner is their row position, overflow slots' owner is ovseg
+            iown = jnp.where(
+                inline != SENT,
+                jnp.arange(B, dtype=jnp.int32)[:, None],
+                -1,
+            ).reshape(-1)
+            oown = jnp.where(
+                ov != SENT, jnp.repeat(ovseg, ops.CHUNK)[: capc * ops.CHUNK].reshape(capc, ops.CHUNK), -1
+            ).reshape(-1)
+            flat = jnp.concatenate([inline.reshape(-1), ov.reshape(-1)])
+            segf = jnp.concatenate([iown, oown])
             if keeps[i] is not None:
                 keep = ops.member_mask(flat, keeps[i])
                 flat = jnp.where(keep, flat, SENT)
@@ -200,15 +211,13 @@ def _run_fused(root_vec, metas, cdsts, luts, keeps, orders, caps, light=False):
                     ranks = gather_ranks(vsrc, vranks, flat)
                     perm = segmented_sort_perm(segf, ranks, desc)
                 else:
-                    # pure windowing: keep matrix order, just group by
-                    # parent (stable sort on segment only)
+                    # pure windowing: group by parent, keep matrix order
+                    # (inline-then-overflow == ascending per parent)
                     perm = segmented_sort_perm(
                         segf, jnp.zeros_like(flat), False
                     )
                 flat = flat[perm]
                 segf = segf[perm]
-                # per-parent window: position within the (now contiguous)
-                # segment = iota - running segment start
                 n = flat.shape[0]
                 iota = jnp.arange(n, dtype=jnp.int32)
                 is_first = jnp.concatenate(
@@ -229,9 +238,14 @@ def _run_fused(root_vec, metas, cdsts, luts, keeps, orders, caps, light=False):
             else:
                 parts += [total.reshape(1)]
         else:
-            nxt = ops.sort_unique(out2d.reshape(-1))[:cap_u]
+            nxt = ops.sort_unique(
+                jnp.concatenate([inline.reshape(-1), ov.reshape(-1)])
+            )[:cap_u]
             if not light:
-                parts += [out2d.reshape(-1), seg, nxt, total.reshape(1)]
+                parts += [
+                    inline.reshape(-1), ov.reshape(-1), ovseg, nxt,
+                    total.reshape(1),
+                ]
             elif need_dest:
                 parts += [nxt, total.reshape(1)]
             else:
@@ -331,13 +345,14 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
             order_statics.append(None)
             orders.append(None)
 
-    caps: List[Tuple[int, int, bool, bool, Optional[tuple]]] = []
+    caps: List[Tuple[int, int, int, bool, bool, Optional[tuple]]] = []
+    B = ops.bucket(max(1, len(src)))  # row-vector length entering level i
     m = len(src)  # bound on the unique frontier entering each level
     for i, a in enumerate(arenas):
         if i == 0:
-            capc = int(arenas[0].chunk_degree_of_rows(rows0).sum())
+            capc = int(arenas[0].ov_chunk_degree_of_rows(rows0).sum())
         else:
-            capc = int(_topm_chunk_sum(a, m))
+            capc = int(_topm_ov_chunk_sum(a, m))
         capc = ops.bucket(max(1, capc))
         if capc > max_capc:
             return False
@@ -345,8 +360,9 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
         # target count (NOT the source-uid universe: row-less leaf uids
         # exceed it, and truncating them would corrupt light-mode dest
         # sets and var bindings)
+        slots = B * ops.INLINE + capc * ops.CHUNK
         nd = max(1, a.n_distinct_dst())
-        cap_u = ops.bucket(max(1, min(capc * ops.CHUNK, nd)))
+        cap_u = ops.bucket(max(1, min(slots, nd)))
         sg = levels[i]
         # does anything on the host consume this level's dest set?
         need_dest = (
@@ -355,20 +371,21 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
             or i == len(levels) - 1
         )
         decorated = keeps[i] is not None or order_statics[i] is not None
-        caps.append((capc, cap_u, need_dest, decorated, order_statics[i]))
-        m = min(capc * ops.CHUNK, nd)
+        caps.append((B, capc, cap_u, need_dest, decorated, order_statics[i]))
+        m = min(slots, nd)
+        B = cap_u
 
-    metas, cdsts, luts = [], [], []
+    metas, ovs, luts = [], [], []
     for a in arenas:
-        m8, cd = a.chunked()
-        metas.append(m8)
-        cdsts.append(cd)
+        mp, ov = a.inline_layout()
+        metas.append(mp)
+        ovs.append(ov)
         luts.append(a.lut(universe))
 
-    root_vec = jnp.asarray(ops.pad_to(src, ops.bucket(max(1, len(src)))))
+    root_vec = jnp.asarray(ops.pad_to(src, caps[0][0]))
     packed = np.asarray(  # ONE device round trip for the whole chain
         _run_fused(
-            root_vec, tuple(metas), tuple(cdsts), tuple(luts),
+            root_vec, tuple(metas), tuple(ovs), tuple(luts),
             tuple(keeps), tuple(orders), tuple(caps),
             light=light,
         )
@@ -377,7 +394,7 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
     # --- host conversion: packed buffer → engine results per level ---
     src_list = np.asarray(src, dtype=np.int64)
     pos = 0
-    for sg, (capc, cap_u, need_dest, decorated, _ostat) in zip(levels, caps):
+    for sg, (B, capc, cap_u, need_dest, decorated, _ostat) in zip(levels, caps):
         # the fused program already applied these; the engine must not
         # re-apply them to the stashed matrices
         sg.chain_filtered = decorated and sg.filter is not None
@@ -395,30 +412,62 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
             sg.chain_stash = ("light", dest, src_list, total)
             src_list = dest
             continue
-        flat = packed[pos : pos + capc * ops.CHUNK]
-        pos += capc * ops.CHUNK
-        if decorated:
-            owner = packed[pos : pos + capc * ops.CHUNK]  # per-slot owners
-            pos += capc * ops.CHUNK
-        else:
-            seg = packed[pos : pos + capc]
-            pos += capc
-            owner = np.repeat(seg, ops.CHUNK)
-        nxt = packed[pos : pos + cap_u]
-        pos += cap_u
-        pos += 1  # total (unused in full mode: lengths say it)
-        valid = flat != SENT
-        out_flat = flat[valid].astype(np.int64)
-        owner = owner[valid]
         n_src = len(src_list)
-        counts = np.bincount(owner, minlength=n_src)[:n_src]
         if decorated:
+            flat_len = B * ops.INLINE + capc * ops.CHUNK
+            flat = packed[pos : pos + flat_len]
+            pos += flat_len
+            owner = packed[pos : pos + flat_len]
+            pos += flat_len
+            valid = flat != SENT
+            out_flat = flat[valid].astype(np.int64)
+            owner = owner[valid]
+            counts = np.bincount(owner, minlength=n_src)[:n_src]
             # per-parent order survives, but slots of one parent may be
             # interleaved with SENT gaps: regroup stably by owner
             grp = np.argsort(owner, kind="stable")
             out_flat = out_flat[grp]
-        seg_ptr = np.zeros(n_src + 1, dtype=np.int64)
-        np.cumsum(counts, out=seg_ptr[1:])
+        else:
+            inline = packed[pos : pos + B * ops.INLINE].reshape(B, ops.INLINE)
+            pos += B * ops.INLINE
+            ovflat = packed[pos : pos + capc * ops.CHUNK]
+            pos += capc * ops.CHUNK
+            ovseg = packed[pos : pos + capc]
+            pos += capc
+            # reassemble the uid matrix: per row, inline heads (the FIRST
+            # min(deg, INLINE) targets, ascending) then overflow tails
+            # (also ascending) — concatenation preserves per-row order
+            iv = inline[:n_src] != SENT
+            ci = iv.sum(axis=1)
+            ow = np.repeat(ovseg, ops.CHUNK)
+            ovalid = (ovflat != SENT) & (ow >= 0) & (ow < n_src)
+            ovals = ovflat[ovalid].astype(np.int64)
+            ow = ow[ovalid]
+            co = np.bincount(ow, minlength=n_src)[:n_src]
+            counts = ci + co
+            seg_ptr0 = np.zeros(n_src + 1, dtype=np.int64)
+            np.cumsum(counts, out=seg_ptr0[1:])
+            out_flat = np.empty(int(seg_ptr0[-1]), dtype=np.int64)
+            # inline placement: position = row start + within-row ordinal
+            within_i = np.cumsum(iv, axis=1) - iv
+            dest_i = seg_ptr0[:n_src, None] + within_i
+            out_flat[dest_i[iv]] = inline[:n_src][iv].astype(np.int64)
+            # overflow placement: grouped by ascending owner, so within-
+            # group ordinal = index minus its run start
+            if len(ovals):
+                idx = np.arange(len(ow))
+                first = np.r_[True, ow[1:] != ow[:-1]]
+                run_start = idx[first][np.cumsum(first) - 1]
+                dest_o = seg_ptr0[ow] + ci[ow] + (idx - run_start)
+                out_flat[dest_o] = ovals
+        nxt = packed[pos : pos + cap_u]
+        pos += cap_u
+        pos += 1  # total (unused in full mode: lengths say it)
+        if decorated:
+            seg_ptr = np.zeros(n_src + 1, dtype=np.int64)
+            np.cumsum(counts, out=seg_ptr[1:])
+        else:
+            seg_ptr = seg_ptr0
         sg.chain_stash = ("full", out_flat, seg_ptr, src_list)
         src_list = nxt[nxt != SENT].astype(np.int64)
     return True
@@ -448,14 +497,16 @@ def _resolve_filter_global(engine, ft, resolver) -> np.ndarray:
     raise QueryError("not-filter is not chain-fusable")
 
 
-def _topm_chunk_sum(arena, m: int) -> int:
-    """Upper bound on the chunk-degree sum of ANY m distinct rows: the
-    cumsum of the descending-sorted per-row chunk degrees (cached)."""
-    cs = getattr(arena, "_topm_cdeg", None)
+def _topm_ov_chunk_sum(arena, m: int) -> int:
+    """Upper bound on the OVERFLOW-chunk sum of ANY m distinct rows: the
+    cumsum of the descending-sorted per-row overflow chunk degrees
+    (cached; inline-head layout stores the first INLINE targets in the
+    metadata row, so only degree>INLINE rows have chunks)."""
+    cs = getattr(arena, "_topm_ovdeg", None)
     if cs is None:
-        C = ops.CHUNK
         deg = arena.h_offsets[1:] - arena.h_offsets[:-1]
-        cdeg = np.sort((deg + C - 1) // C)[::-1]
+        ovdeg = np.maximum(deg - ops.INLINE, 0)
+        cdeg = np.sort((ovdeg + ops.CHUNK - 1) // ops.CHUNK)[::-1]
         cs = np.concatenate([[0], np.cumsum(cdeg)])
-        arena._topm_cdeg = cs
+        arena._topm_ovdeg = cs
     return int(cs[min(m, len(cs) - 1)])

@@ -338,3 +338,35 @@ def test_expand_inline_grouped_matches_reference():
     want, _ = a.expand_host(f)
     assert int(total) == len(want)
     assert np.array_equal(np.sort(got), np.sort(want.astype(np.int32)))
+
+
+def test_expand_inline_seg_owners():
+    """expand_inline_seg's overflow owners reconstruct the exact per-row
+    uid matrix (inline-then-overflow per row, ascending)."""
+    import numpy as np
+    import jax
+    from dgraph_tpu import ops
+    from dgraph_tpu.models.arena import csr_from_edges
+    from dgraph_tpu.ops.sets import SENT
+
+    rng = np.random.default_rng(3)
+    src = rng.integers(1, 200, size=3000)
+    dst = rng.integers(1, 5000, size=3000)
+    a = csr_from_edges(src, dst)
+    metap, ov = a.inline_layout()
+    rows = np.array([0, -1, 3, 5, 9, 20, -1, 40, a.n_rows - 1], np.int32)
+    capc = ops.bucket_fine(int(a.ov_chunk_degree_of_rows(rows).sum()) or 1)
+    inline, ovout, total, ovseg = ops.expand_inline_seg(
+        metap, ov, jax.device_put(rows), capc
+    )
+    inline, ovout, ovseg = map(np.asarray, (inline, ovout, ovseg))
+    want, wptr = a.expand_host(rows)
+    assert int(total) == len(want)
+    # reassemble per-row: inline lanes then overflow chunks owned by it
+    for i, r in enumerate(rows):
+        exp = want[wptr[i] : wptr[i + 1]].astype(np.int64)
+        inl = inline[i][inline[i] != SENT].astype(np.int64)
+        ovi = ovout[ovseg == i].reshape(-1)
+        ovi = ovi[ovi != SENT].astype(np.int64)
+        got = np.concatenate([inl, ovi])
+        assert np.array_equal(got, exp), (i, r)
