@@ -70,6 +70,18 @@ class PredicateData:
             m = self._untagged = (arr, vals)
         return m
 
+    def untagged_lookup(self, uids):
+        """Vectorized untagged-value probe: (hit_mask, positions) into the
+        mirror's value array for ``uids`` (int64 ndarray).  Shared by the
+        engine's value-leaf fetch and groupby."""
+        import numpy as _np
+
+        mu, mv = self.untagged_mirror()
+        if not len(mu):
+            return _np.zeros(len(uids), bool), _np.zeros(len(uids), _np.int64), mv
+        pos = _np.clip(_np.searchsorted(mu, uids), 0, len(mu) - 1)
+        return mu[pos] == uids, pos, mv
+
     def uids_with_data(self) -> Set[int]:
         out = set(self.edges.keys())
         out.update(u for (u, _l) in self.values.keys())

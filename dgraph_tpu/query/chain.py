@@ -196,7 +196,9 @@ def _run_fused(root_vec, metas, ovs, luts, keeps, orders, caps, light=False):
                 -1,
             ).reshape(-1)
             oown = jnp.where(
-                ov != SENT, jnp.repeat(ovseg, ops.CHUNK)[: capc * ops.CHUNK].reshape(capc, ops.CHUNK), -1
+                ov != SENT,
+                jnp.broadcast_to(ovseg[:, None], (capc, ops.CHUNK)),
+                -1,
             ).reshape(-1)
             flat = jnp.concatenate([inline.reshape(-1), ov.reshape(-1)])
             segf = jnp.concatenate([iown, oown])
@@ -362,7 +364,10 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
         # sets and var bindings)
         slots = B * ops.INLINE + capc * ops.CHUNK
         nd = max(1, a.n_distinct_dst())
-        cap_u = ops.bucket(max(1, min(slots, nd)))
+        # clamp to the actual slot count: slots is no longer a power of
+        # two, and a cap_u above it would make the device's [:cap_u]
+        # slice SHORTER than the host parser reads (buffer misalignment)
+        cap_u = min(ops.bucket(max(1, min(slots, nd))), slots)
         sg = levels[i]
         # does anything on the host consume this level's dest set?
         need_dest = (

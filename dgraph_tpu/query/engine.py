@@ -418,11 +418,8 @@ class QueryEngine:
                     # vectorized untagged fetch: one searchsorted over the
                     # predicate's sorted value mirror instead of a Python
                     # dict probe per uid (VERDICT r3 weak #6)
-                    mu, mv = pd.untagged_mirror()
-                    if len(mu):
-                        pos = np.searchsorted(mu, src)
-                        pos = np.clip(pos, 0, len(mu) - 1)
-                        hit = mu[pos] == src
+                    hit, pos, mv = pd.untagged_lookup(src)
+                    if hit.any():
                         hs = src[hit].tolist()
                         hv = mv[pos[hit]].tolist()
                         vals = dict(zip(map(int, hs), hv))

@@ -237,3 +237,34 @@ def test_chain_negative_first_falls_back():
     still match the reference path."""
     q = "{ d(func: uid(1, 2)) { film (orderasc: year, first: -3) { _uid_ } } }"
     assert _film_engine(1).run(q) == _film_engine(1 << 60).run(q)
+
+
+def test_chain_cap_u_clamped_to_slot_count():
+    """Regression (round-4 review): when every target is distinct,
+    n_distinct_dst >= slots made cap_u = bucket(slots) exceed the actual
+    slot count, misaligning the packed buffer (light mode crashed with
+    IndexError; full mode silently fell back)."""
+    from dgraph_tpu.models import PostingStore
+    from dgraph_tpu.query.engine import QueryEngine
+
+    def mk(threshold):
+        st = PostingStore()
+        eng = QueryEngine(st)
+        lines = []
+        # 16 roots x 14 distinct targets -> slots = B*6 + capc*8 not pow2
+        t = 10_000
+        for r in range(1, 17):
+            for k in range(14):
+                t += 1
+                lines.append(f"<0x{r:x}> <knows> <0x{t:x}> .")
+                lines.append(f"<0x{t:x}> <likes> <0x{t + 50_000:x}> .")
+        eng.run("mutation { set { %s } }" % "\n".join(lines))
+        eng.chain_threshold = threshold
+        return eng
+
+    q = ("{ var(func: uid(%s)) { x as knows { likes } } "
+         "  r(func: uid(x)) { _uid_ } }" % ", ".join(str(i) for i in range(1, 17)))
+    got = mk(0).run(q)
+    want = mk(10**18).run(q)
+    assert got == want
+    assert len(got["r"]) == 16 * 14
