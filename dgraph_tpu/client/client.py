@@ -24,8 +24,18 @@ class Transport:
 
 
 class HttpTransport(Transport):
-    def __init__(self, addr: str):
+    """HTTP transport; ``binary=True`` requests protobuf wire-format
+    responses (Accept: application/protobuf — the reference's gRPC
+    Response surface, serve/proto.py) and decodes them to the JSON path's
+    result-dict shape, with proto3's inherent divergences: a ONE-element
+    scalar list decodes as the bare scalar (repeated-field ambiguity,
+    serve/proto.py decode_node docstring) and mutation code/message
+    strings are not carried (Response has no fields for them).  Wire
+    bytes are ~2-5× smaller than JSON for uid-heavy results."""
+
+    def __init__(self, addr: str, binary: bool = False):
         self.addr = addr.rstrip("/")
+        self.binary = binary
 
     def run(self, text: str, variables: Optional[dict] = None) -> dict:
         req = urllib.request.Request(
@@ -33,9 +43,19 @@ class HttpTransport(Transport):
         )
         if variables:
             req.add_header("X-Dgraph-Vars", json.dumps(variables))
+        if self.binary:
+            req.add_header("Accept", "application/protobuf")
         try:
             with urllib.request.urlopen(req, timeout=60) as resp:
-                out = json.loads(resp.read().decode())
+                raw = resp.read()
+                if self.binary and resp.headers.get("Content-Type", "").startswith(
+                    "application/protobuf"
+                ):
+                    from dgraph_tpu.serve.proto import decode_response
+
+                    out = decode_response(raw)
+                else:
+                    out = json.loads(raw.decode())
         except urllib.error.HTTPError as e:
             # the server answers errors with a JSON {code, message} body;
             # surface the message, not just the status line

@@ -196,9 +196,17 @@ def encode_response(out: Dict[str, Any]) -> bytes:
             continue
         root = bytearray(_str_field(1, "_root_"))
         items = v if isinstance(v, list) else [v]
+        wrote = 0
         for obj in items:
             if isinstance(obj, dict):
                 root += _len_field(3, encode_node(k, obj))
+                wrote += 1
+        if not wrote:
+            # empty block: a bare named child keeps the block key on the
+            # wire (JSON surface always reports {"k": []}); the decoder
+            # folds a lone empty object back to [] — unambiguous because
+            # the JSON encoder never emits empty result objects
+            root += _len_field(3, _str_field(1, k))
         buf += _len_field(1, bytes(root))
     lat = out.get("server_latency")
     if lat:
@@ -314,7 +322,10 @@ def decode_response(b: bytes) -> Dict[str, Any]:
         if field == 1:
             _, root = decode_node(v)
             for k, nodes in root.items():
-                out.setdefault(k, []).extend(nodes)
+                if nodes == [{}]:  # empty-block marker (see encode_response)
+                    out.setdefault(k, [])
+                else:
+                    out.setdefault(k, []).extend(nodes)
         elif field == 2:
             lat = {}
             for f2, _, v2 in iter_fields(v):

@@ -159,3 +159,24 @@ def test_set_then_delete_ordering(srv):
 def test_server_stop_idempotent(srv):
     srv.stop()
     srv.stop()  # second call must be a no-op, not a double-close
+
+
+def test_http_transport_binary_protobuf(srv):
+    """binary=True speaks the protobuf wire surface end-to-end and yields
+    the same result dict as the JSON path — including EMPTY blocks, which
+    must not vanish from the wire."""
+    from dgraph_tpu.client.client import HttpTransport
+
+    HttpTransport(srv.addr).run(
+        'mutation { set { <0x61> <name> "Alice" . <0x61> <follows> <0x62> . '
+        '<0x62> <name> "Bob" . } }'
+    )
+    q = "{ q(func: uid(0x61)) { name follows { name } } }"
+    jout = HttpTransport(srv.addr).run(q)
+    bout = HttpTransport(srv.addr, binary=True).run(q)
+    assert bout["q"] == jout["q"]
+    # empty result set: JSON reports {"q": []}; binary must match, not drop
+    q0 = "{ q(func: uid(0x5f)) { name } }"
+    jout = HttpTransport(srv.addr).run(q0)
+    bout = HttpTransport(srv.addr, binary=True).run(q0)
+    assert jout["q"] == [] and bout["q"] == []
