@@ -3,7 +3,10 @@
 Equivalent of the reference's dashboard/ React app (query editor + D3
 force-layout graph view, served at cmd/dgraph/main.go:652) re-done as a
 single dependency-free HTML page: editor, JSON view, SVG force-layout
-graph view, and query history in localStorage.
+graph view, query history in localStorage, a schema browser, a live
+server-stats panel (/debug/store + Prometheus counters), per-run latency
+sparkline, and a debug toggle surfacing the engine's per-stage breakdown
+(chain fusion, device/host ms, edges traversed).
 """
 
 DASHBOARD_HTML = r"""<!doctype html>
@@ -34,6 +37,15 @@ DASHBOARD_HTML = r"""<!doctype html>
   #graph line { stroke:#4a5260; }
   #hist { font-size:12px; color:#8a93a0; max-height:72px; overflow:auto; }
   #hist div { cursor:pointer; padding:1px 0; } #hist div:hover { color:var(--fg); }
+  #side { width:270px; background:var(--panel); border-left:1px solid #2c323b;
+          padding:10px; overflow:auto; font-size:12px; }
+  #side h2 { font-size:12px; margin:10px 0 4px; color:#8a93a0; text-transform:uppercase; }
+  #side table { width:100%; border-collapse:collapse; }
+  #side td { padding:1px 4px 1px 0; border-bottom:1px solid #262c34; }
+  #spark { height:34px; width:100%; background:#181c22; border-radius:4px; }
+  #spark rect { fill:var(--acc); }
+  label.dbg { font-size:12px; color:#8a93a0; display:flex; gap:4px; align-items:center; }
+  #engstats { color:#8a93a0; white-space:pre; font:11px/1.4 ui-monospace,monospace; }
 </style>
 </head>
 <body>
@@ -50,12 +62,19 @@ DASHBOARD_HTML = r"""<!doctype html>
       <button class="alt" onclick="view('json')">JSON</button>
       <button class="alt" onclick="view('graph')">Graph</button>
       <button class="alt" onclick="share()">Share</button>
+      <label class="dbg"><input type="checkbox" id="dbg"> debug</label>
     </div>
     <div id="hist"></div>
+    <div id="engstats"></div>
   </div>
   <div class="col">
     <div id="out">// results</div>
     <svg id="graph"></svg>
+  </div>
+  <div id="side">
+    <h2>latency</h2><svg id="spark"></svg>
+    <h2>schema</h2><table id="schema"><tr><td>…</td></tr></table>
+    <h2>server</h2><table id="stats"><tr><td>…</td></tr></table>
   </div>
 </main>
 <script>
@@ -64,14 +83,66 @@ fetch('/health').then(r=>r.text()).then(t=>$('health').textContent=t==='OK'?'●
 let last = null;
 function view(which){ $('out').style.display = which==='json'?'block':'none';
   $('graph').style.display = which==='graph'?'block':'none'; if(which==='graph') draw(); }
+let lats = [];
 async function run(){
   const q = $('q').value; const t0 = performance.now();
-  const r = await fetch('/query', {method:'POST', body:q});
+  const dbg = $('dbg').checked ? '?debug=true' : '';
+  const r = await fetch('/query' + dbg, {method:'POST', body:q});
   const j = await r.json(); last = j;
   $('out').textContent = JSON.stringify(j, null, 2);
   const sl = j.server_latency || {};
-  $('lat').textContent = 'server ' + (sl.total||'-') + ' · round-trip ' + (performance.now()-t0).toFixed(1) + 'ms';
-  hist(q); view('json');
+  const rt = performance.now() - t0;
+  $('lat').textContent = 'server ' + (sl.total||'-') + ' · round-trip ' + rt.toFixed(1) + 'ms';
+  lats = lats.concat([rt]).slice(-40); spark();
+  // engine per-stage breakdown (debug=true): fusion + device/host split
+  $('engstats').textContent = sl.engine ? Object.entries(sl.engine)
+    .map(([k,v])=>k+': '+v).join('   ') : '';
+  hist(q); view('json'); refreshSide();
+}
+function spark(){
+  const svg = $('spark'); svg.innerHTML = '';
+  if (!lats.length) return;
+  const w = svg.clientWidth || 250, bw = Math.max(2, w/40 - 1), mx = Math.max(...lats);
+  const NS = 'http://www.w3.org/2000/svg';
+  lats.forEach((v,i)=>{
+    const h = Math.max(2, 30*v/mx), r = document.createElementNS(NS,'rect');
+    r.setAttribute('x', i*(bw+1)); r.setAttribute('y', 32-h);
+    r.setAttribute('width', bw); r.setAttribute('height', h);
+    const t = document.createElementNS(NS,'title');
+    t.textContent = v.toFixed(1)+'ms'; r.appendChild(t);
+    svg.appendChild(r);
+  });
+}
+async function refreshSide(){
+  try {
+    // index/tokenizer/reverse/count must be requested explicitly (the
+    // engine defaults schema{} to the type field alone); both fetches
+    // are independent, so they run concurrently
+    const [sr, dr] = await Promise.all([
+      fetch('/query', {method:'POST',
+        body:'schema { type index tokenizer reverse count }'}),
+      fetch('/debug/store'),
+    ]);
+    const sj = await sr.json();
+    const st = $('schema'); st.innerHTML = '';
+    (sj.schema||[]).forEach(p=>{
+      const tr = document.createElement('tr');
+      // textContent throughout: schema strings must never execute
+      [p.predicate, p.type + (p.index?' @index('+(p.tokenizer||[]).join(',')+')':'')
+        + (p.reverse?' @reverse':'') + (p.count?' @count':'')]
+        .forEach(txt=>{ const td=document.createElement('td'); td.textContent=txt; tr.appendChild(td); });
+      st.appendChild(tr);
+    });
+    const dj = await dr.json();
+    const tbl = $('stats'); tbl.innerHTML = '';
+    Object.entries(dj).forEach(([k,v])=>{
+      if (typeof v === 'object') return;
+      const tr = document.createElement('tr');
+      [k, String(v)].forEach(txt=>{ const td=document.createElement('td');
+        td.textContent=txt; tr.appendChild(td); });
+      tbl.appendChild(tr);
+    });
+  } catch(e) {}
 }
 function hist(q){
   let h = JSON.parse(localStorage.getItem('dgh')||'[]');
@@ -129,7 +200,7 @@ function draw(){
     const t=document.createElementNS(NS,'text');
     t.setAttribute('x',n.x+8); t.setAttribute('y',n.y+4); t.textContent=n.label; svg.appendChild(t); }
 }
-renderHist();
+renderHist(); refreshSide(); setInterval(refreshSide, 15000);
 </script>
 </body>
 </html>
