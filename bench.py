@@ -53,7 +53,9 @@ def ensure_backend() -> str:
 
         jax.config.update("jax_platforms", "cpu")
         return "cpu"
-    retries = int(os.environ.get("BENCH_INIT_RETRIES", 3))
+    # round-end runs are one-shot: wait out a recovering tunnel (5 probes
+    # with exponential backoff ≈ 13 minutes max) before settling for CPU
+    retries = int(os.environ.get("BENCH_INIT_RETRIES", 5))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
     last = ""
     for attempt in range(retries):
