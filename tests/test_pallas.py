@@ -1,0 +1,105 @@
+"""Pallas fused slot-map kernel == the XLA scatter/scan construction.
+
+Runs in Pallas interpret mode (CPU backend, like the rest of the suite).
+Interpret mode skips Mosaic lowering: TPU compilation is intended but
+unverified until the next real-chip session (see the kernel docstring).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _grouped_case(rng, n_rows, pcap):
+    """Random grouped-prefix inputs: strictly-ascending chunk starts for
+    n_rows productive rows (cd >= 1), zero-padded to pcap."""
+    cd = rng.integers(1, 6, size=n_rows).astype(np.int32)
+    gaps = rng.integers(0, 3, size=n_rows).astype(np.int64)
+    cs = np.zeros(n_rows, dtype=np.int32)
+    nxt = 0
+    for i in range(n_rows):
+        nxt += int(gaps[i])
+        cs[i] = nxt
+        nxt += int(cd[i])
+    csp = np.zeros(pcap, np.int32)
+    cdp = np.zeros(pcap, np.int32)
+    csp[:n_rows] = cs
+    cdp[:n_rows] = cd
+    return csp, cdp
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_slotmap_pallas_matches_reference(seed):
+    from dgraph_tpu.ops.pallas_slotmap import slotmap_pallas, slotmap_reference
+
+    rng = np.random.default_rng(seed)
+    pcap, capc, q = 256, 512, 3
+    css, cds, want = [], [], []
+    for i in range(q):
+        n = int(rng.integers(1, pcap // 2))
+        cs, cd = _grouped_case(rng, n, pcap)
+        css.append(cs)
+        cds.append(cd)
+        want.append(slotmap_reference(cs[:n], cd[:n], capc))
+    got = np.asarray(
+        slotmap_pallas(
+            jnp.asarray(np.stack(css)), jnp.asarray(np.stack(cds)), capc,
+            interpret=True,
+        )
+    )
+    for i in range(q):
+        assert np.array_equal(got[i], want[i]), i
+
+
+def test_slotmap_pallas_matches_xla_slotmap():
+    """The kernel and the production XLA scatter/scan construction agree
+    on the same inputs (chunkid equality on the valid span)."""
+    from dgraph_tpu.ops.pallas_slotmap import slotmap_pallas
+    from dgraph_tpu.ops.sets import _ov_slot_map
+
+    rng = np.random.default_rng(7)
+    pcap, capc = 128, 256
+    cs, cd = _grouped_case(rng, 50, pcap)
+    chunkid, ok, _cstart, _prod = jax.jit(
+        lambda c, d: _ov_slot_map(c, d, capc), static_argnums=()
+    )(jnp.asarray(cs), jnp.asarray(cd))
+    xla = np.where(np.asarray(ok), np.asarray(chunkid), -1)
+    pal = np.asarray(
+        slotmap_pallas(
+            jnp.asarray(cs[None, :]), jnp.asarray(cd[None, :]), capc,
+            interpret=True,
+        )
+    )[0]
+    assert np.array_equal(pal, xla)
+
+
+def test_slotmap_pallas_dense_and_edge_cases():
+    from dgraph_tpu.ops.pallas_slotmap import slotmap_pallas, slotmap_reference
+
+    pcap, capc = 128, 256
+    # dense: no gaps, all cd=1 (identity mapping)
+    cs = np.arange(pcap, dtype=np.int32)
+    cd = np.ones(pcap, np.int32)
+    got = np.asarray(
+        slotmap_pallas(jnp.asarray(cs[None]), jnp.asarray(cd[None]), capc,
+                       interpret=True)
+    )[0]
+    assert np.array_equal(got, slotmap_reference(cs, cd, capc))
+    # single giant row spanning several blocks
+    cs2 = np.zeros(pcap, np.int32)
+    cd2 = np.zeros(pcap, np.int32)
+    cs2[0], cd2[0] = 17, 200
+    got = np.asarray(
+        slotmap_pallas(jnp.asarray(cs2[None]), jnp.asarray(cd2[None]), capc,
+                       interpret=True)
+    )[0]
+    assert np.array_equal(got, slotmap_reference(cs2[:1], cd2[:1], capc))
+    # empty prefix: everything -1
+    z = np.zeros(pcap, np.int32)
+    got = np.asarray(
+        slotmap_pallas(jnp.asarray(z[None]), jnp.asarray(z[None]), capc,
+                       interpret=True)
+    )[0]
+    assert (got == -1).all()
