@@ -177,3 +177,44 @@ def test_reads_correct_during_mutations(srv):
     for t in rs:
         t.join(timeout=10)
     assert not errs, errs[:3]
+
+
+def test_concurrent_reads_under_eviction_pressure():
+    """Readers racing LRU eviction (arena budget) stay correct: an arena
+    popped from the cache mid-request keeps serving its holder, and the
+    next request rebuilds it from the store."""
+    import numpy as np
+
+    from dgraph_tpu.models.arena import ArenaManager
+    from dgraph_tpu.models.store import Edge
+
+    store = PostingStore()
+    preds = [f"e{i}" for i in range(8)]
+    for i, p in enumerate(preds):
+        store.apply_many([Edge(pred=p, src=s, dst=s + 10 + i) for s in range(1, 60)])
+    one = ArenaManager(store).data(preds[0]).device_bytes()
+    am = ArenaManager(store, budget_bytes=int(one * 2.2))
+
+    errs = []
+
+    def reader(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for _ in range(60):
+                p = preds[int(rng.integers(len(preds)))]
+                i = int(p[1:])
+                a = am.data(p)
+                out, _ = a.expand_host(a.rows_for_uids_host(np.array([5, 30])))
+                assert list(out) == [15 + i, 40 + i], (p, out)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=reader, args=(s,)) for s in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in ts), "reader deadlocked"
+    assert not errs, errs[:2]
+    assert am.evictions > 0  # pressure actually occurred
+    assert sum(am._lru.values()) <= int(one * 2.2) + one  # bounded
