@@ -193,6 +193,9 @@ def test_concurrent_reads_under_eviction_pressure():
     for i, p in enumerate(preds):
         store.apply_many([Edge(pred=p, src=s, dst=s + 10 + i) for s in range(1, 60)])
     one = ArenaManager(store).data(preds[0]).device_bytes()
+    # the sizing probe's refresh drained the shared store's dirty marks;
+    # restore them so ``am`` exercises its own refresh path from scratch
+    store.dirty.update(preds)
     am = ArenaManager(store, budget_bytes=int(one * 2.2))
 
     errs = []
@@ -209,12 +212,17 @@ def test_concurrent_reads_under_eviction_pressure():
         except Exception as e:  # pragma: no cover
             errs.append(e)
 
-    ts = [threading.Thread(target=reader, args=(s,)) for s in range(6)]
+    ts = [threading.Thread(target=reader, args=(s,), daemon=True) for s in range(6)]
     for t in ts:
         t.start()
     for t in ts:
         t.join(timeout=60)
+    # daemon threads: a wedged reader FAILS here instead of hanging
+    # interpreter shutdown
     assert not any(t.is_alive() for t in ts), "reader deadlocked"
     assert not errs, errs[:2]
     assert am.evictions > 0  # pressure actually occurred
     assert sum(am._lru.values()) <= int(one * 2.2) + one  # bounded
+    # the O(1) running total must agree with the ground truth — drift
+    # here means over/under-eviction on every future build
+    assert am._lru_total == sum(am._lru.values())
