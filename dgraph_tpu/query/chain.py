@@ -256,6 +256,50 @@ def _run_fused(root_vec, metas, ovs, luts, keeps, orders, caps, light=False):
     return jnp.concatenate(parts)
 
 
+def packed_inline_to_matrix(packed, B, capov, n_src):
+    """Unpack the device's [inline.ravel | ov.ravel | ovseg] buffer and
+    assemble the uid matrix (single owner of the packed layout — the
+    engine's per-level path and the chain's conversion both route here
+    via inline_to_matrix)."""
+    inline = packed[: B * ops.INLINE].reshape(B, ops.INLINE)
+    ovflat = packed[B * ops.INLINE : B * ops.INLINE + capov * ops.CHUNK]
+    ovseg = packed[B * ops.INLINE + capov * ops.CHUNK :]
+    return inline_to_matrix(inline, ovflat, ovseg, n_src)
+
+
+def inline_to_matrix(inline, ovflat, ovseg, n_src):
+    """Host assembly of the engine uid-matrix from an inline-head
+    expansion: per row, inline heads (the FIRST min(deg, INLINE) targets,
+    ascending) then overflow tails (also ascending) — concatenation
+    preserves per-row ascending order.  Shared by the fused chain's
+    full-mode conversion and the engine's per-level device path.
+
+    inline: int32[B, INLINE]; ovflat: int32[capc*CHUNK]; ovseg: int32[capc]
+    (owner row per overflow chunk, -1 pad); n_src: true row count (<= B).
+    Returns (out_flat int64[total], seg_ptr int64[n_src+1])."""
+    iv = inline[:n_src] != SENT
+    ci = iv.sum(axis=1)
+    ow = np.repeat(ovseg, ops.CHUNK)
+    ovalid = (ovflat != SENT) & (ow >= 0) & (ow < n_src)
+    ovals = ovflat[ovalid].astype(np.int64)
+    ow = ow[ovalid]
+    co = np.bincount(ow, minlength=n_src)[:n_src]
+    counts = ci + co
+    seg_ptr = np.zeros(n_src + 1, dtype=np.int64)
+    np.cumsum(counts, out=seg_ptr[1:])
+    out_flat = np.empty(int(seg_ptr[-1]), dtype=np.int64)
+    within_i = np.cumsum(iv, axis=1) - iv
+    dest_i = seg_ptr[:n_src, None] + within_i
+    out_flat[dest_i[iv]] = inline[:n_src][iv].astype(np.int64)
+    if len(ovals):
+        idx = np.arange(len(ow))
+        first = np.r_[True, ow[1:] != ow[:-1]]
+        run_start = idx[first][np.cumsum(first) - 1]
+        dest_o = seg_ptr[ow] + ci[ow] + (idx - run_start)
+        out_flat[dest_o] = ovals
+    return out_flat, seg_ptr
+
+
 def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
     """Attempt fused execution of the chain rooted at ``child`` with
     frontier ``src``.  On success, stages (out_flat, seg_ptr) on every
@@ -439,32 +483,7 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
             pos += capc * ops.CHUNK
             ovseg = packed[pos : pos + capc]
             pos += capc
-            # reassemble the uid matrix: per row, inline heads (the FIRST
-            # min(deg, INLINE) targets, ascending) then overflow tails
-            # (also ascending) — concatenation preserves per-row order
-            iv = inline[:n_src] != SENT
-            ci = iv.sum(axis=1)
-            ow = np.repeat(ovseg, ops.CHUNK)
-            ovalid = (ovflat != SENT) & (ow >= 0) & (ow < n_src)
-            ovals = ovflat[ovalid].astype(np.int64)
-            ow = ow[ovalid]
-            co = np.bincount(ow, minlength=n_src)[:n_src]
-            counts = ci + co
-            seg_ptr0 = np.zeros(n_src + 1, dtype=np.int64)
-            np.cumsum(counts, out=seg_ptr0[1:])
-            out_flat = np.empty(int(seg_ptr0[-1]), dtype=np.int64)
-            # inline placement: position = row start + within-row ordinal
-            within_i = np.cumsum(iv, axis=1) - iv
-            dest_i = seg_ptr0[:n_src, None] + within_i
-            out_flat[dest_i[iv]] = inline[:n_src][iv].astype(np.int64)
-            # overflow placement: grouped by ascending owner, so within-
-            # group ordinal = index minus its run start
-            if len(ovals):
-                idx = np.arange(len(ow))
-                first = np.r_[True, ow[1:] != ow[:-1]]
-                run_start = idx[first][np.cumsum(first) - 1]
-                dest_o = seg_ptr0[ow] + ci[ow] + (idx - run_start)
-                out_flat[dest_o] = ovals
+            out_flat, seg_ptr0 = inline_to_matrix(inline, ovflat, ovseg, n_src)
         nxt = packed[pos : pos + cap_u]
         pos += cap_u
         pos += 1  # total (unused in full mode: lengths say it)

@@ -44,10 +44,30 @@ def _make_packed_expand():
     return run
 
 
-# expand_csr with (out | seg) concatenated on device: one host fetch
-# instead of two (each fetch pays a full transport round trip).
-# Module-level so the jit cache persists across queries.
+def _make_packed_inline():
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnames=("capc",))
+    def run(metap, ov_chunks, rows, capc):
+        inline, ov, _total, ovseg = ops.expand_inline_seg(
+            metap, ov_chunks, rows, capc
+        )
+        return jnp.concatenate([inline.reshape(-1), ov.reshape(-1), ovseg])
+
+    return run
+
+
+# device expansions with everything concatenated on device: one host fetch
+# instead of several (each fetch pays a full transport round trip).
+# Module-level so the jit cache persists across queries.  The CSR form
+# stays live as the fallback for NON-ASCENDING frontiers (ordered roots,
+# recurse orderings): expand_inline_seg's slot map requires
+# ascending-distinct rows, expand_csr accepts any order.
 _packed_expand_csr = _make_packed_expand()
+_packed_expand_inline = _make_packed_inline()
 
 
 def _fresh_stats() -> dict:
@@ -547,7 +567,31 @@ class QueryEngine:
             self.stats["edges"] += len(out)
             self.stats["host_expand_ms"] += (_time.perf_counter() - t0) * 1e3
             return out, seg_ptr
+        # big single-device expansion.  The inline-head fast path (one
+        # 32B row gather serves metadata + the first INLINE targets;
+        # docs/ROOFLINE.md round 4) requires ASCENDING-distinct rows —
+        # an ordered root permutes the frontier, so those fall back to
+        # the order-agnostic CSR gather.
+        valid_rows = rows[rows >= 0]
+        ascending = bool(np.all(valid_rows[1:] > valid_rows[:-1]))
         t0 = _time.perf_counter()
+        if ascending:
+            metap, ov_chunks = arena.inline_layout()
+            B = ops.bucket(n)
+            capov = ops.bucket(
+                max(1, int(arena.ov_chunk_degree_of_rows(rows).sum()))
+            )
+            packed = np.asarray(  # one fetch: inline|ov|ovseg concatenated
+                _packed_expand_inline(
+                    metap, ov_chunks, ops.pad_rows(rows, B), capov
+                )
+            )
+            self.stats["device_expand_ms"] += (_time.perf_counter() - t0) * 1e3
+            from dgraph_tpu.query.chain import packed_inline_to_matrix
+
+            out, seg_ptr = packed_inline_to_matrix(packed, B, capov, n)
+            self.stats["edges"] += len(out)
+            return out, seg_ptr
         arena.ensure_device()  # re-upload after incremental host deltas
         packed = np.asarray(  # one fetch: out|seg concatenated on device
             _packed_expand_csr(

@@ -408,3 +408,70 @@ def test_regexp_group_quantifier_not_pruned(engine):
     # (son)* — group contents are optional, must not be required trigrams
     got = engine.run('{ me(func: regexp(name, /Silas(son)* Reed/)) { name } }')
     assert got == {"me": [{"name": "Silas Reed"}]}
+
+
+def test_per_level_device_path_matches_host():
+    """The per-level DEVICE expansion (inline-head) must equal the host
+    path exactly — matrices, order, seg_ptr — for mixed-degree frontiers
+    including missing rows (forced by expand_device_min=0)."""
+    import numpy as np
+
+    from dgraph_tpu.models import PostingStore
+    from dgraph_tpu.query.engine import QueryEngine
+
+    def build(eng):
+        lines = []
+        rng = np.random.default_rng(9)
+        for u in range(1, 200):
+            for d in rng.integers(1, 400, size=int(rng.integers(0, 14))):
+                lines.append(f"<0x{u:x}> <e> <0x{int(d):x}> .")
+        eng.run("mutation { set { %s } }" % "\n".join(lines))
+
+    host = QueryEngine(PostingStore())
+    build(host)
+    host.expand_device_min = 1 << 62
+    host.chain_threshold = 1 << 62
+    dev = QueryEngine(PostingStore())
+    build(dev)
+    dev.expand_device_min = 0
+    dev.chain_threshold = 1 << 62  # isolate the per-level path
+    q = "{ q(func: uid(%s)) { e { _uid_ e { _uid_ } } } }" % ", ".join(
+        str(u) for u in range(1, 60)
+    )
+    a, b = host.run(q), dev.run(q)
+    assert a == b
+    assert dev.stats["device_expand_ms"] > 0  # the device path really ran
+    assert host.stats["device_expand_ms"] == 0
+
+
+def test_per_level_device_path_ordered_root():
+    """Regression (round-4 review): an ORDERED root permutes the frontier,
+    violating the inline path's ascending-rows precondition — the device
+    branch must detect it and stay correct (CSR fallback)."""
+    import numpy as np
+
+    from dgraph_tpu.models import PostingStore
+    from dgraph_tpu.query.engine import QueryEngine
+
+    def build(eng):
+        lines = []
+        rng = np.random.default_rng(4)
+        for u in range(1, 120):
+            lines.append(f'<0x{u:x}> <rank> "{int(rng.integers(0, 1000))}"^^<xs:int> .')
+            for d in rng.integers(1, 400, size=int(rng.integers(4, 14))):
+                lines.append(f"<0x{u:x}> <e> <0x{int(d):x}> .")
+        eng.run("mutation { set { %s } }" % "\n".join(lines))
+
+    q = ('{ q(func: has(e), orderdesc: rank, first: 40) '
+         "{ e { _uid_ } } }")
+    host = QueryEngine(PostingStore())
+    build(host)
+    host.expand_device_min = 1 << 62
+    host.chain_threshold = 1 << 62
+    dev = QueryEngine(PostingStore())
+    build(dev)
+    dev.expand_device_min = 0
+    dev.chain_threshold = 1 << 62
+    a, b = host.run(q), dev.run(q)
+    assert a == b
+    assert dev.stats["device_expand_ms"] > 0
