@@ -370,3 +370,48 @@ def test_expand_inline_seg_owners():
         ovi = ovi[ovi != SENT].astype(np.int64)
         got = np.concatenate([inl, ovi])
         assert np.array_equal(got, exp), (i, r)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_expand_inline_seg_fuzz(seed):
+    """Randomized graphs × random ascending frontiers with skips: the
+    inline+overflow reassembly must equal expand_host exactly (values,
+    per-row grouping, order)."""
+    import numpy as np
+    import jax
+    from dgraph_tpu import ops
+    from dgraph_tpu.models.arena import csr_from_edges
+    from dgraph_tpu.ops.sets import SENT
+    from dgraph_tpu.query.chain import inline_to_matrix
+
+    rng = np.random.default_rng(100 + seed)
+    n_nodes = int(rng.integers(20, 400))
+    n_edges = int(rng.integers(1, 3000))
+    src = rng.integers(1, n_nodes + 1, size=n_edges)
+    # mix: mostly small rows + a few heavy hubs straddling chunk bounds
+    dst = rng.integers(1, 4 * n_nodes, size=n_edges)
+    hub = int(rng.integers(1, n_nodes + 1))
+    extra = rng.integers(1, 4 * n_nodes, size=int(rng.integers(0, 90)))
+    src = np.concatenate([src, np.full(len(extra), hub)])
+    dst = np.concatenate([dst, extra])
+    a = csr_from_edges(src, dst)
+    metap, ov = a.inline_layout()
+
+    n_pick = int(rng.integers(1, a.n_rows + 1))
+    rows = np.sort(rng.choice(a.n_rows, size=n_pick, replace=False)).astype(np.int32)
+    # interleave skips
+    skips = rng.random(n_pick) < 0.2
+    rows_sk = rows.copy()
+    rows_sk[skips] = -1
+    capc = ops.bucket_fine(int(a.ov_chunk_degree_of_rows(rows_sk).sum()) or 1)
+    inline, ovout, total, ovseg = ops.expand_inline_seg(
+        metap, ov, jax.device_put(rows_sk), capc
+    )
+    out, seg_ptr = inline_to_matrix(
+        np.asarray(inline), np.asarray(ovout).reshape(-1), np.asarray(ovseg),
+        len(rows_sk),
+    )
+    want, wptr = a.expand_host(rows_sk)
+    assert int(total) == len(want)
+    assert np.array_equal(out, want)
+    assert np.array_equal(seg_ptr, wptr)
