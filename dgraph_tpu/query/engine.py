@@ -644,12 +644,31 @@ class QueryEngine:
             return
         counts = np.diff(sg.seg_ptr)
         owner = np.repeat(np.arange(len(counts)), counts)
-        srcs = sg.src_uids[owner].tolist()  # vectorized gather, then probe
+        srcs = sg.src_uids[owner]
+        dsts = sg.out_flat
         ef = pd.edge_facets
-        for src, dst in zip(srcs, sg.out_flat.tolist()):
-            f = ef.get((dst, src) if sg.reverse else (src, dst))
-            if f:
-                sg.edge_facets[(src, dst)] = f
+        if pd._efmirror is None and len(dsts) * 8 < len(ef):
+            # cold mirror + small result: direct dict probes beat paying
+            # an O(F log F) mirror rebuild for a handful of edges (the
+            # mirror amortizes across queries once built; any facet WRITE
+            # invalidates it, so mutate-then-query workloads land here)
+            for src, dst in zip(srcs.tolist(), dsts.tolist()):
+                f = ef.get((dst, src) if sg.reverse else (src, dst))
+                if f:
+                    sg.edge_facets[(src, dst)] = f
+            return
+        # one vectorized probe over the predicate's sorted facet mirror
+        # (the per-edge dict loop was the r3-flagged host bottleneck)
+        if sg.reverse:
+            hit, pos, mv = pd.edge_facets_lookup(dsts, srcs)
+        else:
+            hit, pos, mv = pd.edge_facets_lookup(srcs, dsts)
+        if hit.any():
+            hs = srcs[hit].tolist()
+            hd = dsts[hit].tolist()
+            hf = mv[pos[hit]].tolist()
+            for src, dst, f in zip(hs, hd, hf):
+                sg.edge_facets[(int(src), int(dst))] = f
 
     def _apply_facet_filter(self, sg: SubGraph):
         """@facets(eq(key, val)): keep edges whose facets satisfy the tree."""
