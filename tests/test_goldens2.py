@@ -649,3 +649,38 @@ def test_mutation_comments_between_sections():
 def test_eq_int_list(eng):
     got = q(eng, "{ me(func: eq(age, [29, 40]), orderasc: name) { name } }")
     assert [x["name"] for x in got["me"]] == ["Ben", "Cara Lee", "Dan"]
+
+
+def test_pagination_window_boundaries():
+    """Window edge cases against reference semantics (query_test.go
+    pagination tables): offset beyond the list, first+offset past the
+    end, zero first, negative first (last N), after beyond max."""
+    from dgraph_tpu.models import PostingStore
+    from dgraph_tpu.query.engine import QueryEngine
+
+    eng = QueryEngine(PostingStore())
+    lines = ["<0x1> <f> <0x%x> ." % (0x10 + i) for i in range(6)]
+    eng.run("mutation { set { %s } }" % "\n".join(lines))
+
+    def uids(out):
+        # a parent whose windowed edge list is empty is omitted entirely
+        # (encode_node drops empty objects, matching the reference)
+        if not out["q"]:
+            return []
+        return [int(x["_uid_"], 16) for x in out["q"][0].get("f", [])]
+
+    base = [0x10 + i for i in range(6)]
+    cases = [
+        ("{ q(func: uid(0x1)) { f (first: 3) { _uid_ } } }", base[:3]),
+        ("{ q(func: uid(0x1)) { f (offset: 4) { _uid_ } } }", base[4:]),
+        ("{ q(func: uid(0x1)) { f (offset: 9) { _uid_ } } }", []),
+        ("{ q(func: uid(0x1)) { f (first: 4, offset: 4) { _uid_ } } }", base[4:]),
+        ("{ q(func: uid(0x1)) { f (first: 0) { _uid_ } } }", base),
+        ("{ q(func: uid(0x1)) { f (first: -2) { _uid_ } } }", base[-2:]),
+        ("{ q(func: uid(0x1)) { f (after: 0x12) { _uid_ } } }", base[3:]),
+        ("{ q(func: uid(0x1)) { f (after: 0x15) { _uid_ } } }", []),
+        ("{ q(func: uid(0x1)) { f (after: 0x12, first: 2) { _uid_ } } }", base[3:5]),
+    ]
+    for q, want in cases:
+        got = uids(eng.run(q))
+        assert got == want, (q, got, want)
