@@ -331,6 +331,10 @@ def run_bench(scale: float):
                 "value": round(dev_eps, 1),
                 "unit": "edges/s",
                 "vs_baseline": round(dev_eps / cpu_eps, 3),
+                # self-describing record: a wedged-TPU round falls back to
+                # XLA-on-CPU (see ensure_backend) and must not read as a
+                # TPU measurement
+                "platform": jax.devices()[0].platform,
             }
         )
     )
