@@ -311,7 +311,18 @@ class CSRArena:
 
         adds/dels: int64[n, 2] (src, dst) arrays; adds must not already
         exist, dels must exist (the store journal guarantees both).
+
+        Runs under _BUILD_LOCK: in clustered mode refresh() applies
+        deltas while readers run (ClusterStore drains dirty marks inside
+        peek), so mirror mutation must be mutually exclusive with the
+        lazy derived-layout builds (inline_layout/chunked also take this
+        lock) — otherwise a build that sampled the mirrors pre-delta
+        could cache a torn layout AFTER the invalidation below.
         """
+        with _BUILD_LOCK:
+            self._apply_delta_locked(adds, dels)
+
+    def _apply_delta_locked(self, adds: np.ndarray, dels: np.ndarray) -> None:
         h_dst = self.host_dst().astype(np.int64, copy=False)
         # absolute edge positions via the composite (row, dst) key — the
         # CSR flat dst IS sorted by it

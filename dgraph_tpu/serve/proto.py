@@ -328,8 +328,13 @@ def decode_response(b: bytes) -> Dict[str, Any]:
                     out.setdefault(k, []).extend(nodes)
         elif field == 2:
             lat = {}
+            lat_names = {1: "parsing", 2: "processing", 3: "pb"}
             for f2, _, v2 in iter_fields(v):
-                lat[{1: "parsing", 2: "processing", 3: "pb"}[f2]] = v2.decode()
+                # proto3 unknown-field tolerance: a newer server may add
+                # Latency fields old clients must skip, not crash on
+                name = lat_names.get(f2)
+                if name is not None:
+                    lat[name] = v2.decode()
             out["server_latency"] = lat
         elif field == 3:
             name = uid = None

@@ -220,8 +220,10 @@ class DgraphServer:
                 next(self._dump_seq),
             )
             with open(_os.path.join(self.dumpsg_path, name), "w") as f:
-                json.dump(dump, f, indent=1)
-        except OSError:  # dump failures must never fail the query
+                # default=str: a non-JSON-able value (e.g. a numpy scalar
+                # in params) must degrade to its repr, not a TypeError
+                json.dump(dump, f, indent=1, default=str)
+        except (OSError, ValueError):  # dump failures must never fail the query
             pass
 
     def _run_locked(self, parsed, out: dict) -> dict:

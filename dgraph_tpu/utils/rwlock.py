@@ -8,6 +8,13 @@ concurrent read-only queries, exclusive mutations.  Python's stdlib has no
 RW lock; this is the classic two-condition implementation with writer
 preference (a waiting writer blocks new readers, so a mutation stream
 cannot be starved by a heavy read load).
+
+NOT reentrant, on either side.  A thread already holding the read side
+that re-acquires it deadlocks whenever a writer is queued (new readers
+block on _writers_waiting) — and the deadlock is load-dependent, so it
+would pass quiet tests and hang in production.  acquire_read therefore
+tracks holder thread idents and raises RuntimeError on recursive
+acquisition instead of deadlocking.
 """
 
 from __future__ import annotations
@@ -22,16 +29,25 @@ class RWLock:
         self._readers = 0          # active readers
         self._writer = False       # a writer holds the lock
         self._writers_waiting = 0  # writers queued (blocks new readers)
+        self._reader_idents: set[int] = set()  # recursive-read detection
 
     def acquire_read(self) -> None:
+        ident = threading.get_ident()
         with self._cond:
+            if ident in self._reader_idents:
+                raise RuntimeError(
+                    "recursive RWLock.acquire_read from the same thread "
+                    "(would deadlock whenever a writer is queued)"
+                )
             while self._writer or self._writers_waiting:
                 self._cond.wait()
             self._readers += 1
+            self._reader_idents.add(ident)
 
     def release_read(self) -> None:
         with self._cond:
             self._readers -= 1
+            self._reader_idents.discard(threading.get_ident())
             if self._readers == 0:
                 self._cond.notify_all()
 

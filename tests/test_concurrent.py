@@ -77,6 +77,20 @@ def test_rwlock_writer_preference():
     assert got_w.is_set() and got_r2.is_set()
 
 
+def test_rwlock_recursive_read_raises():
+    # non-reentrant by design: a nested read from the same thread would
+    # deadlock whenever a writer is queued, so it must raise instead
+    lk = RWLock()
+    with lk.read():
+        with pytest.raises(RuntimeError, match="recursive"):
+            lk.acquire_read()
+    # the failed acquire must not corrupt state: lock still usable
+    with lk.read():
+        pass
+    with lk.write():
+        pass
+
+
 # --------------------------------------------------- engine-level overlap
 
 
