@@ -408,10 +408,16 @@ def expand_inline(
 # Grouped (skey) coding for inline arenas: stored target ids carry a
 # "no-overflow" bit above the uid so one value sort groups rows WITH
 # overflow chunks into an ascending prefix — the slot-map scatter then
-# runs on a short static prefix instead of the whole frontier.  Capacity:
-# uid < 2^22 (≈4.2M rows per arena shard; bigger arenas use the plain
-# layout).  SENT still sorts last (2^23 << SENT).
-GROUP_BIT = 22
+# runs on a short static prefix instead of the whole frontier.
+#
+# Capacity: uid < 2^29 (536M rows per arena shard — an order of magnitude
+# above the 21M flagship corpus; beyond it callers fall back to the plain
+# inline layout).  The bit budget is exact: max skey = (2^29 - 1) | 2^29 =
+# 2^30 - 1 < SENT (2^31 - 1), so SENT still sorts strictly last and no
+# encoded value can collide with it.  GROUP_BIT = 30 would make
+# uid 2^30 - 1 with the no-overflow bit encode EXACTLY SENT — that one
+# uid would vanish into padding — hence 29 is the int32 ceiling.
+GROUP_BIT = 29
 GROUP_MASK = (1 << GROUP_BIT) - 1
 
 

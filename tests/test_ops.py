@@ -340,6 +340,57 @@ def test_expand_inline_grouped_matches_reference():
     assert np.array_equal(np.sort(got), np.sort(want.astype(np.int32)))
 
 
+def test_grouped_layout_above_4m_uids():
+    """The grouped fast path must survive uid spaces beyond the OLD
+    2^22 (~4.2M) ceiling — full-Freebase-scale predicates hit that on day
+    one.  GROUP_BIT is now 29 (536M uids); this pins the cliff fix by
+    exercising uids straddling 2^22, including overflow rows up there."""
+    import numpy as np
+    import jax
+    from dgraph_tpu import ops
+    from dgraph_tpu.models.arena import csr_dense_from_edges
+    from dgraph_tpu.ops.sets import SENT, GROUP_MASK, GROUP_BIT
+
+    assert (1 << GROUP_BIT) > 4_500_000  # the cliff itself is gone
+    rng = np.random.default_rng(11)
+    n = 4_500_000  # > old 2^22 cap
+    lo, hi = (1 << 22) - 64, n  # cluster activity around/above the old cliff
+    src = rng.integers(lo, hi, size=6000)
+    src[:1500] = (1 << 22) + 17  # a fat overflow row ABOVE the old cap
+    dst = rng.integers(lo, hi, size=6000)
+    a = csr_dense_from_edges(src, dst, n)
+    metap, ov = a.inline_layout_grouped()  # must NOT raise ValueError
+    deg = a.h_offsets[1:] - a.h_offsets[:-1]
+    f = np.unique(rng.integers(lo, hi, size=128))
+    f = np.append(f, (1 << 22) + 17)
+    key = np.asarray(ops.skey_encode(f, deg[f] > ops.INLINE))
+    f = f[np.argsort(key)]
+    pcap = ops.bucket_fine(int((deg[f] > ops.INLINE).sum()) or 1)
+    capc = ops.bucket_fine(int(a.ov_chunk_degree_of_rows(f).sum()) or 1)
+    rows = jax.device_put(np.asarray(f, np.int32))
+    inline, ovout, total = ops.expand_inline_grouped(metap, ov, rows, capc, pcap)
+    got = np.concatenate(
+        [np.asarray(inline).reshape(-1), np.asarray(ovout).reshape(-1)]
+    )
+    got = got[got != SENT] & int(GROUP_MASK)
+    want, _ = a.expand_host(f)
+    assert int(total) == len(want)
+    assert np.array_equal(np.sort(got), np.sort(want.astype(np.int32)))
+
+
+def test_skey_encode_no_sent_collision():
+    """Max-uid no-overflow skey must stay strictly below SENT (the bit
+    budget documented at GROUP_BIT: 2^30 - 1 < 2^31 - 1)."""
+    import numpy as np
+    from dgraph_tpu import ops
+    from dgraph_tpu.ops.sets import SENT, GROUP_BIT
+
+    top = np.array([(1 << GROUP_BIT) - 1], np.int64)
+    enc = ops.skey_encode(top, np.array([False]))
+    assert 0 < int(enc[0]) < SENT
+    assert int(np.asarray(ops.skey_uid(enc))[0]) == (1 << GROUP_BIT) - 1
+
+
 def test_expand_inline_seg_owners():
     """expand_inline_seg's overflow owners reconstruct the exact per-row
     uid matrix (inline-then-overflow per row, ascending)."""
