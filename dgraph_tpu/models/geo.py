@@ -149,6 +149,23 @@ def haversine_m(a: Tuple[float, float], b: Tuple[float, float]) -> float:
     return 2 * EARTH_RADIUS_M * math.asin(math.sqrt(h))
 
 
+def haversine_m_vec(q: Tuple[float, float], lngs, lats):
+    """Vectorized haversine: distance (meters) from ``q`` to every
+    (lngs[i], lats[i]) pair — the near() exact post-filter runs over the
+    whole candidate column in one numpy pass (functions.py)."""
+    import numpy as np
+
+    lng1, lat1 = map(math.radians, q)
+    lng2 = np.radians(np.asarray(lngs, dtype=np.float64))
+    lat2 = np.radians(np.asarray(lats, dtype=np.float64))
+    dlat, dlng = lat2 - lat1, lng2 - lng1
+    h = (
+        np.sin(dlat / 2) ** 2
+        + math.cos(lat1) * np.cos(lat2) * np.sin(dlng / 2) ** 2
+    )
+    return 2 * EARTH_RADIUS_M * np.arcsin(np.sqrt(h))
+
+
 def point_in_polygon(pt: Tuple[float, float], ring: Sequence[Tuple[float, float]]) -> bool:
     """Ray casting, for the exact post-filter (geofilter.go MatchesFilter)."""
     x, y = pt

@@ -38,7 +38,8 @@ class PredicateData:
     __slots__ = ("edges", "values", "edge_facets", "value_facets",
                  "_has_langs",  # lazy lang-presence flag (functions.py)
                  "_untagged",   # lazy vectorized value mirror (below)
-                 "_efmirror")   # lazy vectorized edge-facet mirror
+                 "_efmirror",   # lazy vectorized edge-facet mirror
+                 "_wdmirror")   # lazy sorted uids-with-data mirror
 
     def __init__(self):
         # src uid -> set of dst uids
@@ -51,6 +52,7 @@ class PredicateData:
         self.value_facets: Dict[int, Dict[str, TypedValue]] = {}
         self._untagged = None
         self._efmirror = None
+        self._wdmirror = None
 
     def untagged_mirror(self):
         """Vectorized mirror of the untagged values: (sorted int64 uid
@@ -116,6 +118,21 @@ class PredicateData:
         out = set(self.edges.keys())
         out.update(u for (u, _l) in self.values.keys())
         return out
+
+    def uids_with_data_sorted(self):
+        """Sorted int64 array of uids_with_data, cached until the next
+        mutation (apply() clears the slot unconditionally).  The engine's
+        ``_predicate_`` probe runs ONE searchsorted per predicate over
+        this instead of a Python set probe per uid × per predicate."""
+        m = self._wdmirror
+        if m is None:
+            import numpy as _np
+
+            s = self.uids_with_data()
+            m = _np.fromiter(s, dtype=_np.int64, count=len(s))
+            m.sort()
+            self._wdmirror = m
+        return m
 
 
 class PostingStore:
@@ -208,6 +225,7 @@ class PostingStore:
         posting/index.go:273 — index derivation happens at arena build)."""
         p = self.pred(e.pred)
         self.dirty.add(e.pred)
+        p._wdmirror = None  # any mutation can change uids-with-data
         if e.op == "set":
             if e.value is not None:
                 p.values[(e.src, e.lang)] = e.value

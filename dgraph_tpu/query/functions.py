@@ -332,8 +332,24 @@ class FuncResolver:
                 if pd
                 else _EMPTY
             )
-        out = []
         langs = fn.lang.split(",") if fn.lang else [""]
+        if langs == [""]:
+            # untagged fast path: ONE searchsorted over the cached value
+            # mirror replaces the per-uid store.value dict chain; the
+            # remaining per-candidate cost is rx.search itself (C code)
+            pd = self.store.peek(fn.attr)
+            if pd is None or not len(cand):
+                return _EMPTY
+            hit, pos, mv = pd.untagged_lookup(cand)
+            uids = cand[hit]
+            vals = mv[pos[hit]]
+            keep = np.fromiter(
+                (rx.search(str(v.value)) is not None for v in vals),
+                dtype=bool,
+                count=len(vals),
+            )
+            return np.unique(uids[keep])
+        out = []
         for u in cand.tolist():
             for l in langs:
                 v = (
@@ -386,20 +402,38 @@ class FuncResolver:
             if r >= 0:
                 sets.append(self._expand_rows(idx.csr, np.array([r])))
         cand = np.unique(np.concatenate(sets)) if sets else _EMPTY
-        # exact post-filter (types/geofilter.go FilterGeoUids:325)
-        out = []
-        for u in cand.tolist():
-            v = self.store.value(fn.attr, int(u))
-            if v is None:
-                continue
-            g = v.value
-            if fn.name == "near":
-                ok = g.kind == "Point" and geomod.haversine_m(q.coords, g.coords) <= max_m
-            else:
-                ok = geomod.matches_filter(fn.name, q, g)
-            if ok:
-                out.append(u)
-        return np.array(sorted(out), dtype=np.int64)
+        # exact post-filter (types/geofilter.go FilterGeoUids:325),
+        # vectorized: ONE searchsorted over the untagged value mirror
+        # replaces the per-uid store.value probe, and near()'s haversine
+        # runs over the whole Point column in one numpy pass.  Polygon
+        # predicates (within/contains/intersects) still walk per geometry
+        # — ring math is data-dependent — but over mirror-gathered values.
+        pd = self.store.peek(fn.attr)
+        if pd is None or not len(cand):
+            return _EMPTY
+        hit, pos, mv = pd.untagged_lookup(cand)
+        uids = cand[hit]
+        geoms = mv[pos[hit]]
+        if fn.name == "near":
+            is_pt = np.fromiter(
+                (v.value.kind == "Point" for v in geoms),
+                dtype=bool,
+                count=len(geoms),
+            )
+            uids = uids[is_pt]
+            pts = geoms[is_pt]
+            if not len(pts):
+                return _EMPTY
+            lngs = np.fromiter((v.value.coords[0] for v in pts), np.float64, len(pts))
+            lats = np.fromiter((v.value.coords[1] for v in pts), np.float64, len(pts))
+            keep = geomod.haversine_m_vec(q.coords, lngs, lats) <= max_m
+        else:
+            keep = np.fromiter(
+                (geomod.matches_filter(fn.name, q, v.value) for v in geoms),
+                dtype=bool,
+                count=len(geoms),
+            )
+        return np.sort(uids[keep])
 
     def _count_compare(self, fn: Function, candidates: Optional[np.ndarray]) -> np.ndarray:
         if not fn.args:
