@@ -1,0 +1,123 @@
+"""Mesh-sharded engine golden parity (VERDICT r4 weak #5).
+
+A representative slice of the golden matrix — filters, order×pagination,
+recurse, shortest, facets, vars, aggregation, math, groupby, cascade,
+normalize, expand() — runs through the engine with uid-range row
+sharding over the 8-device virtual mesh (shard_threshold=1 forces every
+expansion onto the sharded path) and must return byte-identical JSON to
+the single-device engine.  Two mesh geometries are covered: pure model
+(1×8) and combined data+model (2×4) — the cross-group fan-out this
+replaces is the reference's worker/task.go:54-120 ProcessTaskOverNetwork.
+"""
+
+import jax
+import pytest
+
+from dgraph_tpu.models import PostingStore
+from dgraph_tpu.parallel import make_mesh
+from dgraph_tpu.query import QueryEngine
+
+from test_goldens import RDF, SCHEMA
+
+SHAPES = [
+    # --- root functions + filters
+    "{ q(func: uid(0x1)) { name friend { name } } }",
+    '{ q(func: eq(name, "Ann")) { _uid_ age } }',
+    '{ q(func: anyofterms(name, "Ann Lee")) { name } }',
+    '{ q(func: allofterms(name, "Cara Lee")) { name } }',
+    "{ q(func: ge(age, 29)) { name age } }",
+    "{ q(func: has(weight)) { name weight } }",
+    '{ q(func: uid(0x1)) { friend @filter(ge(age, 30)) { name } } }',
+    '{ q(func: uid(0x1)) { friend @filter(ge(age, 29) AND le(age, 35)) { name } } }',
+    '{ q(func: uid(0x1)) { friend @filter(NOT eq(name, "Ben")) { name } } }',
+    '{ q(func: regexp(name, /^A.*a$/)) { name } }',
+    "{ q(func: ge(count(cares_for), 2)) { name } }",
+    # --- order × pagination
+    "{ q(func: has(age), orderasc: age) { name age } }",
+    "{ q(func: has(age), orderdesc: age, first: 3) { name age } }",
+    "{ q(func: has(age), orderasc: age, offset: 2, first: 2) { name } }",
+    "{ q(func: uid(0x1)) { cares_for (orderasc: age) { name age } } }",
+    "{ q(func: uid(0x1)) { cares_for (orderdesc: age, first: 2) { name } } }",
+    # --- reverse edges + count leaves
+    "{ q(func: uid(0xa)) { ~cares_for { name } } }",
+    "{ q(func: uid(0x1)) { count(cares_for) count(friend) } }",
+    # --- recurse / shortest
+    "{ q(func: uid(0x1)) @recurse(depth: 3) { name friend } }",
+    "{ q(func: uid(0x4)) @recurse(depth: 4, loop: false) { name friend } }",
+    "{ shortest(from: 0x1, to: 0x4) { friend } }",
+    "{ shortest(from: 0x4, to: 0x3) { friend } }",
+    # --- facets: output, filter, order
+    "{ q(func: uid(0x1)) { cares_for @facets { name } } }",
+    "{ q(func: uid(0x1)) { cares_for @facets(level) { name } } }",
+    "{ q(func: uid(0x1)) { cares_for @facets(ge(level, 2)) { name } } }",
+    "{ q(func: uid(0x1)) { cares_for @facets(orderasc: level) { name } } }",
+    # --- vars: uid + value, val() reuse
+    """{ A as var(func: uid(0x1)) { friend { a as age } }
+         q(func: uid(A)) { name mx: max(val(a)) } }""",
+    """{ var(func: uid(0x1)) { f as friend }
+         q(func: uid(f), orderasc: age) { name } }""",
+    # --- aggregation + math
+    "{ q(func: uid(0x1)) { cares_for { age } mn: min(val(z)) var(func: uid(0x1)) { cares_for { z as age } } } }",
+    """{ var(func: uid(0x1)) { cares_for { z as age } }
+         q(func: uid(0x1)) { s: sum(val(z)) avg(val(z)) } }""",
+    # --- groupby
+    "{ q(func: uid(0x1)) { cares_for @groupby(age) { count(_uid_) } } }",
+    # --- cascade / normalize / expand
+    "{ q(func: has(age)) @cascade { name weight } }",
+    "{ q(func: uid(0x1)) @normalize { n: name friend { fn: name } } }",
+    "{ q(func: uid(0x2)) { expand(_all_) } }",
+    # --- lang chains
+    '{ q(func: uid(0x1)) { name@ru name@hu:en name@xx:. } }',
+    # --- _predicate_ (vectorized probe, VERDICT r4 weak #4)
+    "{ q(func: uid(0x2)) { _predicate_ } }",
+]
+
+
+def _engine(mesh=None):
+    e = (
+        QueryEngine(PostingStore(), mesh=mesh, shard_threshold=1)
+        if mesh is not None
+        else QueryEngine(PostingStore())
+    )
+    e.run("mutation { schema { %s } set { %s } }" % (SCHEMA, RDF))
+    return e
+
+
+@pytest.fixture(scope="module")
+def plain():
+    return _engine()
+
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8-device mesh"
+)
+
+
+@needs_mesh
+class TestMeshGoldens:
+    @pytest.fixture(scope="class")
+    def meshed(self):
+        return _engine(make_mesh(8, data=1))
+
+    @pytest.mark.parametrize("shape", SHAPES)
+    def test_shape(self, plain, meshed, shape):
+        assert meshed.run(shape) == plain.run(shape)
+
+    def test_sharded_path_engaged(self, meshed):
+        meshed.run(SHAPES[0])
+        assert meshed.arenas._sharded, "sharded arenas never built"
+
+
+@needs_mesh
+class TestMeshGoldensDataModel:
+    """Same matrix over a COMBINED data+model (2×4) mesh: the data axis
+    batches queries while the model axis row-shards arenas, so shardings
+    compose the way the multi-host dryrun exercises them."""
+
+    @pytest.fixture(scope="class")
+    def meshed(self):
+        return _engine(make_mesh(8, data=2))
+
+    @pytest.mark.parametrize("shape", SHAPES)
+    def test_shape(self, plain, meshed, shape):
+        assert meshed.run(shape) == plain.run(shape)
