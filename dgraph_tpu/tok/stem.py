@@ -160,11 +160,136 @@ def _stem_es(w: str) -> str:
     return w
 
 
-_STEMMERS = {"de": _stem_de, "fr": _stem_fr, "es": _stem_es}
+def _stem_it(w: str) -> str:
+    """Light Snowball Italian: derivational suffixes in R2, verb endings
+    (RV approximated by R1), then the residual final vowel."""
+    V = "aeiouy"
+    r1 = _r1(w, V)
+    r2 = len(w[:r1]) + _r1(w[r1:], V) if r1 < len(w) else len(w)
+    for suf in (
+        "amenti", "imenti", "amento", "imento", "azioni", "azione",
+        "atrici", "atrice", "logie", "logia", "mente", "ibili", "abili",
+        "ibile", "abile", "anze", "anza", "iche", "ichi", "ismi", "ismo",
+        "iste", "isti", "ista", "ose", "osi", "osa", "oso", "ive", "ivi",
+        "iva", "ivo", "ico", "ica", "ici",
+    ):
+        if w.endswith(suf) and len(w) - len(suf) >= r2:
+            w = w[: -len(suf)]
+            break
+    for suf in (
+        "erebbero", "irebbero", "assero", "essero", "issero", "eranno",
+        "iranno", "iscono", "iscano", "avamo", "evamo", "ivamo", "avano",
+        "evano", "ivano", "assi", "ando", "endo", "iamo", "ano", "ono",
+        "ato", "ata", "ati", "ate", "ito", "ita", "iti", "ite", "ava",
+        "eva", "iva", "are", "ere", "ire", "era", "ira",
+    ):
+        if w.endswith(suf) and len(w) - len(suf) >= max(r1, 2):
+            w = w[: -len(suf)]
+            break
+    else:
+        # residual final vowel (canzoni/canzone → canzon)
+        if w and w[-1] in "aeio" and len(w) - 1 >= max(r1, 2):
+            w = w[:-1]
+            if w and w[-1] == "i" and len(w) - 1 >= max(r1, 2):
+                w = w[:-1]
+    return w
+
+
+def _stem_pt(w: str) -> str:
+    """Light Snowball Portuguese: derivational suffixes in R2, verb
+    endings, residual vowel.  Accents/cedilla stripped upstream, so
+    -ção arrives as -cao."""
+    V = "aeiouy"
+    # irregular plural classes conflate with the singular BEFORE region
+    # computation (canções/canção → cancao, animais/animal → animal)
+    if w.endswith("oes") and len(w) > 4:
+        w = w[:-3] + "ao"
+    elif w.endswith("ais") and len(w) > 4:
+        w = w[:-2] + "l"
+    elif w.endswith("eis") and len(w) > 4:
+        w = w[:-2] + "l"
+    r1 = _r1(w, V)
+    r2 = len(w[:r1]) + _r1(w[r1:], V) if r1 < len(w) else len(w)
+    for suf in (
+        "amentos", "imentos", "amento", "imento", "adoras", "adores",
+        "idades", "logias", "logia", "mente", "acoes", "adora", "istas",
+        "iveis", "ancia", "ivel", "avel", "ador", "idade", "ista", "icos",
+        "icas", "osos", "osas", "ivos", "ivas", "acao", "ico", "ica",
+        "oso", "osa", "ivo", "iva", "eza", "ezas",
+    ):
+        if w.endswith(suf) and len(w) - len(suf) >= r2:
+            w = w[: -len(suf)]
+            break
+    for suf in (
+        "ariamos", "eriamos", "iriamos", "assemos", "essemos", "issemos",
+        "aremos", "eremos", "iremos", "avamos", "aramos", "eramos",
+        "iramos", "iamos", "aram", "eram", "iram", "avam", "ando", "endo",
+        "indo", "ados", "idos", "adas", "idas", "amos", "emos", "imos",
+        "aste", "este", "iste", "aria", "eria", "iria", "asse", "esse",
+        "isse", "ava", "ado", "ido", "ada", "ida", "ara", "era", "ira",
+        "iam", "am", "em", "ar", "er", "ir", "eu", "iu", "ou", "ia",
+    ):
+        if w.endswith(suf) and len(w) - len(suf) >= max(r1, 2):
+            w = w[: -len(suf)]
+            break
+    else:
+        if w.endswith("s") and len(w) - 1 >= 2:
+            w = w[:-1]
+        if w and w[-1] in "aeo" and len(w) - 1 >= max(r1, 2):
+            w = w[:-1]
+    return w
+
+
+def _stem_nl(w: str) -> str:
+    """Light Snowball Dutch: plural/inflection endings gated on R1 with
+    consonant undoubling, then derivational suffixes in R2 (the German
+    cousin — snowball/dutch)."""
+    V = "aeiouy"
+    r1 = _r1(w, V, 3)
+    r2 = len(w[:r1]) + _r1(w[r1:], V) if r1 < len(w) else len(w)
+
+    def undouble(s: str) -> str:
+        if len(s) >= 2 and s[-1] == s[-2] and s[-1] in "bdfgklmnprst":
+            return s[:-1]
+        return s
+
+    if w.endswith("heden") and len(w) - 5 >= r1:
+        w = w[:-5] + "heid"
+    elif w.endswith("ene") and len(w) - 3 >= r1 and (len(w) < 4 or w[-4] not in V):
+        w = undouble(w[:-3])
+    elif w.endswith("en") and len(w) - 2 >= r1 and (len(w) < 3 or w[-3] not in V):
+        w = undouble(w[:-2])
+    elif w.endswith("se") and len(w) - 2 >= r1:
+        w = w[:-2]
+    elif w.endswith("s") and len(w) - 1 >= r1 and len(w) >= 2 and w[-2] not in V + "j":
+        w = w[:-1]
+    # e-deletion (step 2)
+    if w.endswith("e") and len(w) - 1 >= r1 and len(w) >= 2 and w[-2] not in V:
+        w = undouble(w[:-1])
+    # derivational (step 3)
+    if w.endswith("heid") and len(w) - 4 >= r2:
+        w = w[:-4]
+    for suf in ("lijk", "baar", "end", "ing", "bar", "ig"):
+        if w.endswith(suf) and len(w) - len(suf) >= r2:
+            if suf in ("ig", "ing", "end") and len(w) > len(suf) and w[-len(suf) - 1] == "e":
+                break
+            w = undouble(w[: -len(suf)])
+            break
+    return w
+
+
+_STEMMERS = {
+    "de": _stem_de,
+    "fr": _stem_fr,
+    "es": _stem_es,
+    "it": _stem_it,
+    "pt": _stem_pt,
+    "nl": _stem_nl,
+}
 
 # languages with a real stemmer + stopword list (PARITY: the reference
 # ships every snowball language via bleve; we document this set)
-SUPPORTED_LANGS = ("en", "de", "fr", "es")
+SUPPORTED_LANGS = ("en", "de", "fr", "es", "it", "pt", "nl")
 
 
 def stem(word: str, lang: str = "en") -> str:

@@ -22,4 +22,16 @@ STOPWORDS = {
         """el la los las un una unos unas y o pero no con de del al es son
         era en sobre para por este esta estos estas él ella ellos""".split()
     ),
+    "it": frozenset(
+        """il lo la i gli le un uno una e o ma non con di del della al
+        alla in su per da è sono era questo questa questi queste""".split()
+    ),
+    "pt": frozenset(
+        """o a os as um uma uns umas e ou mas não com de do da dos das no
+        na em sobre para por este esta estes estas é são era ele ela""".split()
+    ),
+    "nl": frozenset(
+        """de het een en of maar niet met van te in op voor is zijn was
+        waren als ook aan bij naar over om uit dit dat deze die""".split()
+    ),
 }
