@@ -35,6 +35,9 @@ def build_options(argv=None) -> Options:
                    help="(reserved) separate wal dir; DurableStore keeps wal beside postings")
     p.add_argument("--export", dest="export_path", default=d.export_path)
     p.add_argument("--port", type=int, default=d.port)
+    p.add_argument("--grpc_port", type=int, default=d.grpc_port,
+                   help="gRPC listener port (protos.Dgraph service); "
+                        "0 = http port + 1000, -1 disables")
     p.add_argument("--dumpsg", default=d.dumpsg,
                    help="directory to dump each query's execution-shape "
                         "tree as JSON (offline plan inspection)")
@@ -184,6 +187,18 @@ def main(argv=None) -> int:
     )
     srv.start()
     print(f"dgraph-tpu serving at {srv.addr}  (dashboard at /, queries at /query)")
+    grpc_srv = None
+    if opts.grpc_port >= 0:
+        try:
+            from dgraph_tpu.serve.grpc_server import GrpcServer
+
+            gport = opts.grpc_port or (opts.port + 1000 if opts.port else 0)
+            grpc_srv = GrpcServer(srv, bind=opts.bind, port=gport)
+            grpc_srv.start()
+            print(f"gRPC (protos.Dgraph) at {opts.bind}:{grpc_srv.port}")
+        except ImportError:
+            print("grpcio unavailable; gRPC surface disabled", file=sys.stderr)
+            grpc_srv = None
 
     stop = {"requested": False}
 
@@ -218,6 +233,8 @@ def main(argv=None) -> int:
     # stop() is idempotent and holds its lock through teardown, so this
     # blocks until the store is durably closed even when shutdown was
     # initiated by /admin/shutdown on a daemon thread
+    if grpc_srv is not None:
+        grpc_srv.stop()
     srv.stop()
     dump_profiles()
     return 0

@@ -12,6 +12,7 @@ from dgraph_tpu.client.client import (
     DgraphClient,
     Edge as ClientEdge,
     EmbeddedTransport,
+    GrpcTransport,
     HttpTransport,
 )
 from dgraph_tpu.client.checkpoint import SyncMarks
@@ -22,6 +23,7 @@ __all__ = [
     "DgraphClient",
     "ClientEdge",
     "EmbeddedTransport",
+    "GrpcTransport",
     "HttpTransport",
     "SyncMarks",
     "unmarshal",

@@ -80,6 +80,57 @@ class EmbeddedTransport(Transport):
         return self.server.run_query(text, variables)
 
 
+class GrpcTransport(Transport):
+    """gRPC transport against serve/grpc_server.py — the reference
+    client's native wire (client/client.go over protos.Dgraph/Run).
+    Channels come from a shared refcounted pool with a CheckVersion
+    liveness probe (the worker/conn.go:108 pool analog); call close()
+    to release this transport's reference."""
+
+    _pool = None  # class-level shared ChannelPool
+
+    def __init__(self, target: str):
+        from dgraph_tpu.serve.grpc_server import ChannelPool
+
+        if GrpcTransport._pool is None:
+            GrpcTransport._pool = ChannelPool()
+        self.target = target
+        self._chan = GrpcTransport._pool.get(target)
+        self._run = self._chan.unary_unary("/protos.Dgraph/Run")
+        self._check = self._chan.unary_unary("/protos.Dgraph/CheckVersion")
+        self._assign = self._chan.unary_unary("/protos.Dgraph/AssignUids")
+
+    def run(self, text: str, variables: Optional[dict] = None) -> dict:
+        import grpc
+
+        from dgraph_tpu.serve.grpc_server import encode_request
+        from dgraph_tpu.serve.proto import decode_response
+
+        try:
+            raw = self._run(encode_request(text, variables))
+        except grpc.RpcError as e:
+            raise RuntimeError(e.details() or str(e.code())) from None
+        return decode_response(raw)
+
+    def check_version(self) -> str:
+        from dgraph_tpu.serve.grpc_server import decode_version
+
+        return decode_version(self._check(b""))
+
+    def assign_uids(self, n: int) -> tuple:
+        from dgraph_tpu.serve.grpc_server import (
+            decode_assigned_ids,
+            encode_num,
+        )
+
+        return decode_assigned_ids(self._assign(encode_num(n)))
+
+    def close(self) -> None:
+        if self._chan is not None:
+            GrpcTransport._pool.release(self.target)
+            self._chan = None
+
+
 @dataclass
 class BatchMutationOptions:
     """client/mutations.go:56 BatchMutationOptions."""

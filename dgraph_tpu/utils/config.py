@@ -19,6 +19,10 @@ class Options:
     sync_writes: bool = False
     # serving
     port: int = 8080
+    # gRPC listener (cmd/dgraph/main.go:602 grpcListener; the reference
+    # serves gRPC on its own port next to HTTP).  0 = auto (http port +
+    # 1000, the 8080/9080 convention); -1 disables the gRPC surface.
+    grpc_port: int = 0
     bind: str = "127.0.0.1"
     tls_cert: str = ""   # PEM cert chain; empty = plain HTTP (x/tls_helper.go analog)
     tls_key: str = ""    # PEM key; empty = key inside tls_cert
