@@ -78,6 +78,11 @@ def _fresh_stats() -> dict:
     return {
         "edges": 0,
         "chain_fused_levels": 0,
+        # why fused-chain attempts fell back to per-level execution
+        # (bounded list, one entry per rejected attempt; empty = fused or
+        # never attempted) — the eligibility logic must be debuggable at
+        # benchmark scale, not a silent no (VERDICT r4 weak #2)
+        "chain_reject": [],
         "host_expand_ms": 0.0,
         "device_expand_ms": 0.0,
         "chain_ms": 0.0,

@@ -2,14 +2,14 @@
 
 The reference's primary machine API returns protobuf Response messages
 (protos/graphresponse.proto:24-28 ``service Dgraph { rpc Run (Request)
-returns (Response) }``; query/outputnode.go:240 ToProtocolBuffer).  grpcio
-is not available in this image, but the protobuf *wire format* needs no
-library: this module hand-encodes Response/Node/Property/Value/Latency/
-SchemaNode exactly as proto3 serializes them, so any stock protobuf client
-compiled from graphresponse.proto can decode our bytes.  Served from
-/query when the request carries ``Accept: application/protobuf`` (the
-HTTP/2 framing of gRPC itself is out of scope — PARITY.md records the
-substitution).
+returns (Response) }``; query/outputnode.go:240 ToProtocolBuffer).  The
+protobuf *wire format* needs no library: this module hand-encodes
+Response/Node/Property/Value/Latency/SchemaNode exactly as proto3
+serializes them, so any stock protobuf client compiled from
+graphresponse.proto can decode our bytes.  Served from /query when the
+request carries ``Accept: application/protobuf``, and as the message
+codec under the gRPC transport (serve/grpc_server.py, round 5 — grpcio
+provides the HTTP/2 framing, this module the bytes).
 
 Field numbers below mirror /root/reference/protos/graphresponse.proto:
 
