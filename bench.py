@@ -217,6 +217,17 @@ def run_bench(scale: float):
     else:  # ungrouped rows: the slot-map must span every row
         pcap1, pcap2 = fcap, ucap
 
+    # BENCH_PALLAS=1 swaps the overflow slot-map for the Pallas kernel
+    # (ops/pallas_slotmap.py — ROOFLINE Path-onward #2); the watch loop
+    # A/Bs both and banks the better TPU number.  Grouped layouts only:
+    # the kernel's window-max shortcut needs the productive-prefix
+    # invariant that skey ordering provides.
+    expander = (
+        ops.expand_inline_grouped_pallas
+        if os.environ.get("BENCH_PALLAS") == "1" and grouped
+        else ops.expand_inline_grouped
+    )
+
     # ONE device dispatch for the whole query batch.  Per query the
     # pipeline is the inline-head expansion (ops.expand_inline_grouped):
     # ONE 32-byte row gather serves a row's metadata AND its first INLINE
@@ -227,16 +238,12 @@ def run_bench(scale: float):
     # and the slot-map scan/scatter chain runs on pcap2 rows, not ucap.
     def one_query(frontier):
         rows0 = ops.frontier_rows(frontier)
-        inl1, ov1, t1 = ops.expand_inline_grouped(
-            metap, ov_chunks, rows0, capo1, pcap1
-        )
+        inl1, ov1, t1 = expander(metap, ov_chunks, rows0, capo1, pcap1)
         f1 = ops.sort_unique(
             jnp.concatenate([inl1.reshape(-1), ov1.reshape(-1)])
         )[:ucap]
         rows1 = jnp.where(f1 == SENT, -1, f1 & mask)
-        inl2, ov2, t2 = ops.expand_inline_grouped(
-            metap, ov_chunks, rows1, capo2, pcap2
-        )
+        inl2, ov2, t2 = expander(metap, ov_chunks, rows1, capo2, pcap2)
         # checksum over every produced uid (skey-decoded): forces each
         # query's output to actually materialize (otherwise XLA could DCE
         # all but the last query's gathers, and "edges traversed" would
@@ -335,6 +342,7 @@ def run_bench(scale: float):
                 # XLA-on-CPU (see ensure_backend) and must not read as a
                 # TPU measurement
                 "platform": jax.devices()[0].platform,
+                "pallas_slotmap": os.environ.get("BENCH_PALLAS") == "1",
             }
         )
     )

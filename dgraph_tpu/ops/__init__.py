@@ -17,6 +17,7 @@ from dgraph_tpu.ops.sets import (  # noqa: F401
     expand_inline,
     expand_inline_seg,
     expand_inline_grouped,
+    expand_inline_grouped_pallas,
     skey_encode,
     skey_uid,
     GROUP_BIT,

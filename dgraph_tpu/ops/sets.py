@@ -503,6 +503,56 @@ def expand_inline_grouped(
     return inline, ov, total
 
 
+def _ov_slot_map_pallas(cs: jnp.ndarray, cd: jnp.ndarray, capc: int):
+    """Slot→chunk map via the Pallas kernel (ops/pallas_slotmap.py): one
+    VMEM-resident pass replaces the XLA scatter + three O(n log n) scans
+    (docs/ROOFLINE.md Path-onward #2, ~15-20% of device time).  Inputs
+    pad up to the kernel's 128-lane granularity; off-TPU backends run the
+    kernel in interpret mode so the path stays testable everywhere.
+
+    Returns (chunkid[capc] clipped to >= 0, ok[capc])."""
+    from dgraph_tpu.ops.pallas_slotmap import slotmap_pallas
+
+    pcap = cs.shape[0]
+    pp = ((pcap + 127) >> 7) << 7
+    cc = ((capc + 127) >> 7) << 7
+    csp = jnp.zeros((pp,), jnp.int32).at[:pcap].set(cs)
+    cdp = jnp.zeros((pp,), jnp.int32).at[:pcap].set(cd)
+    interp = jax.default_backend() == "cpu"
+    cid = slotmap_pallas(csp[None], cdp[None], cc, interpret=interp)[0, :capc]
+    ok = cid >= 0
+    return jnp.where(ok, cid, 0), ok
+
+
+@partial(jax.jit, static_argnames=("capc", "pcap"))
+def expand_inline_grouped_pallas(
+    metap: jnp.ndarray,
+    ov_chunks: jnp.ndarray,
+    rows: jnp.ndarray,
+    capc: int,
+    pcap: int,
+):
+    """expand_inline_grouped with the overflow slot-map computed by the
+    Pallas kernel instead of the XLA scatter/scan chain — identical
+    semantics and invariants (productive rows form the ascending prefix
+    of ``rows[:pcap]``; -1 skips only at/after the prefix tail, which the
+    skey-sorted frontiers guarantee since SENT sorts last)."""
+    nc = ov_chunks.shape[0]
+    valid = rows >= 0
+    r = jnp.where(valid, rows, 0)
+    m = metap[r]
+    inline = jnp.where(valid[:, None], m[:, 2:], SENT)
+    dg = jnp.where(valid, m[:, 1], 0)
+    total = jnp.sum(dg).astype(jnp.int32)
+    vp = valid[:pcap]
+    cs = jnp.where(vp, m[:pcap, 0], 0)
+    cd = (jnp.maximum(jnp.where(vp, dg[:pcap], 0) - INLINE, 0) + 7) >> 3
+    chunkid, ok = _ov_slot_map_pallas(cs, cd, capc)
+    ov = ov_chunks[jnp.clip(jnp.where(ok, chunkid, 0), 0, nc - 1)]
+    ov = jnp.where(ok[:, None], ov, SENT)
+    return inline, ov, total
+
+
 @partial(jax.jit, static_argnames=("capc",))
 def expand_inline_seg(
     metap: jnp.ndarray,

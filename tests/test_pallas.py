@@ -75,6 +75,65 @@ def test_slotmap_pallas_matches_xla_slotmap():
     assert np.array_equal(pal, xla)
 
 
+def test_expand_inline_grouped_pallas_matches_xla():
+    """The integrated Pallas-backed grouped expansion (what BENCH_PALLAS=1
+    runs) produces exactly the XLA path's outputs on real arena data."""
+    from dgraph_tpu import ops
+    from dgraph_tpu.models.arena import csr_dense_from_edges
+    from dgraph_tpu.ops.sets import SENT
+
+    rng = np.random.default_rng(9)
+    n = 800
+    src = rng.integers(1, n, size=9000)
+    dst = rng.integers(1, n, size=9000)
+    a = csr_dense_from_edges(src, dst, n)
+    metap, ov = a.inline_layout_grouped()
+    deg = a.h_offsets[1:] - a.h_offsets[:-1]
+    f = np.unique(rng.integers(1, n, size=96))
+    key = np.asarray(ops.skey_encode(f, deg[f] > ops.INLINE))
+    f = f[np.argsort(key)]
+    pcap = ops.bucket_fine(int((deg[f] > ops.INLINE).sum()) or 1)
+    capc = ops.bucket_fine(int(a.ov_chunk_degree_of_rows(f).sum()) or 1)
+    rows = jax.device_put(np.asarray(f, np.int32))
+    want = ops.expand_inline_grouped(metap, ov, rows, capc, pcap)
+    got = ops.expand_inline_grouped_pallas(metap, ov, rows, capc, pcap)
+    for w, g in zip(want, got):
+        assert np.array_equal(np.asarray(w), np.asarray(g))
+
+
+def test_expand_inline_grouped_pallas_under_vmap():
+    """bench.py vmaps the expansion over a query batch: the Pallas path
+    must survive the batching rule with unchanged outputs."""
+    from dgraph_tpu import ops
+    from dgraph_tpu.models.arena import csr_dense_from_edges
+
+    rng = np.random.default_rng(13)
+    n = 400
+    src = rng.integers(1, n, size=4000)
+    dst = rng.integers(1, n, size=4000)
+    a = csr_dense_from_edges(src, dst, n)
+    metap, ov = a.inline_layout_grouped()
+    deg = a.h_offsets[1:] - a.h_offsets[:-1]
+    B = 4
+    frontiers = []
+    for _ in range(B):
+        f = np.unique(rng.integers(1, n, size=48))
+        key = np.asarray(ops.skey_encode(f, deg[f] > ops.INLINE))
+        frontiers.append(ops.pad_to(f[np.argsort(key)].astype(np.int32), 64))
+    rowsb = jnp.asarray(np.stack(frontiers))
+    rowsb = jnp.where(rowsb == ops.SENT, -1, rowsb)
+    pcap, capc = 64, 512
+
+    xla = jax.vmap(
+        lambda r: ops.expand_inline_grouped(metap, ov, r, capc, pcap)
+    )(rowsb)
+    pal = jax.vmap(
+        lambda r: ops.expand_inline_grouped_pallas(metap, ov, r, capc, pcap)
+    )(rowsb)
+    for w, g in zip(xla, pal):
+        assert np.array_equal(np.asarray(w), np.asarray(g))
+
+
 def test_slotmap_pallas_dense_and_edge_cases():
     from dgraph_tpu.ops.pallas_slotmap import slotmap_pallas, slotmap_reference
 
