@@ -808,7 +808,27 @@ class ArenaManager:
         )[1]
 
     def use_mesh_for(self, arena: CSRArena) -> bool:
-        return self.mesh is not None and arena.n_rows >= self.shard_threshold
+        """Route this arena's expansions through the row-sharded mesh?
+
+        Two policies (``shard_policy`` attr, default "rows"):
+          "rows"  — shard at/above shard_threshold rows (explicit operator
+                    knob; the mode every virtual-mesh test pins).
+          "model" — consult the ICI crossover cost model
+                    (parallel/crossover.py): shard when the model predicts
+                    sharded wins for a typical query against this arena's
+                    physical size, or when the arena cannot fit one
+                    chip's HBM at all.  The threshold still floors it.
+        """
+        if self.mesh is None or arena.n_rows < self.shard_threshold:
+            return False
+        if getattr(self, "shard_policy", "rows") == "model":
+            from dgraph_tpu.parallel.crossover import should_shard
+
+            n_model = self.mesh.shape["model"]
+            arena_bytes = 32 * arena.n_rows + 4 * arena.n_edges
+            avg_deg = arena.n_edges / max(1, arena.n_rows)
+            return should_shard(arena_bytes, arena.n_rows, avg_deg, n_model)
+        return True
 
     # -- data / reverse ----------------------------------------------------
 

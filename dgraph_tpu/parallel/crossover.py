@@ -1,0 +1,132 @@
+"""Analytical crossover model: when does ICI-sharded expansion beat
+single-chip?  (VERDICT r4 weak #6: `use_mesh_for` thresholds were
+guesses; this module turns them into a documented cost model.)
+
+The single-chip side uses the MEASURED machine constants from
+docs/ROOFLINE.md (v5e, round-4 isolation experiments): the gather
+engine's per-index cost is flat in access pattern and steps only with
+the physical TABLE size (VMEM-resident ~6.3ns, HBM-tier ≤91MB ~15ns,
+beyond ~128MB ~19-25ns).  The sharded side adds the collective cost of
+re-assembling the frontier/output over ICI: per-hop all_gather of the
+output bytes at the datasheet ICI bandwidth, plus a fixed per-collective
+latency.  ICI constants are v5e datasheet values (no pod is reachable
+from this environment — the single-chip constants are measured, the
+link numbers are labeled estimates and the bench_mesh harness exists to
+replace them with measurements when a pod is available; PARITY.md
+tracks that status).
+
+The reference has NO answer to this question at all: a predicate lives
+wholly in one group (no intra-predicate sharding, SURVEY §5), so its
+crossover is "never".  Ours: shard when (a) the arena cannot fit
+single-chip HBM (forced), or (b) per-shard tables drop below a gather
+tier AND the saved gather time exceeds the added collective time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# --- measured single-chip constants (docs/ROOFLINE.md, v5e) -------------
+GATHER_NS_VMEM = 6.3        # table <= ~2MB
+GATHER_NS_HBM = 15.0        # table <= ~91MB
+GATHER_NS_HBM_COLD = 22.0   # table >= ~128MB (mid-cliff: interpolated)
+VMEM_TIER = 2 << 20
+HBM_FAST_TIER = 91 << 20
+HBM_TOTAL = 16 << 30        # v5e HBM per chip
+
+# --- ICI constants (v5e datasheet; ESTIMATES pending a pod run) ---------
+ICI_BW_BYTES_PER_S = 45e9   # per-link, one direction
+ICI_LAT_S = 2e-6            # fixed per-collective launch+hop latency
+# extra launch/sync cost of a shard_map program vs a single-device one
+# (estimate anchored on the measured ~40µs single-chip dispatch floor,
+# docs/ROOFLINE.md "wall-device gap")
+SHARD_DISPATCH_S = 60e-6
+
+
+def gather_ns(table_bytes: float) -> float:
+    """Per-index gather cost for a table of this physical size."""
+    if table_bytes <= VMEM_TIER:
+        return GATHER_NS_VMEM
+    if table_bytes <= HBM_FAST_TIER:
+        return GATHER_NS_HBM
+    return GATHER_NS_HBM_COLD
+
+
+@dataclass
+class CrossoverEstimate:
+    single_chip_s: float
+    sharded_s: float
+    forced: bool  # arena exceeds single-chip HBM: sharding is not a choice
+
+    @property
+    def speedup(self) -> float:
+        return self.single_chip_s / max(self.sharded_s, 1e-12)
+
+    @property
+    def shard_wins(self) -> bool:
+        return self.forced or self.sharded_s < self.single_chip_s
+
+
+def estimate(
+    arena_bytes: int,
+    frontier_rows: int,
+    out_edges: int,
+    n_devices: int,
+    hbm_bytes: int = HBM_TOTAL,
+    hbm_budget_frac: float = 0.8,
+) -> CrossoverEstimate:
+    """Expected per-query expansion cost, single-chip vs row-sharded.
+
+    arena_bytes: physical size of the gathered tables (metap + overflow).
+    frontier_rows: gather indices per query (meta row gathers; overflow
+      gathers scale with out_edges/CHUNK and ride the same tiers).
+    out_edges: produced edge slots (drives the all_gather payload).
+    """
+    idx = frontier_rows + out_edges / 8.0  # meta + overflow-chunk gathers
+    single = idx * gather_ns(arena_bytes) * 1e-9
+    forced = arena_bytes > hbm_budget_frac * hbm_bytes
+
+    shard_bytes = arena_bytes / n_devices
+    # each shard gathers the FULL frontier against its slice (the
+    # broadcast-frontier design of parallel/mesh.py) but produces only
+    # its rows' output; gather work parallelizes because row ownership
+    # partitions the productive indices.  Per-shard tables still live in
+    # HBM — a small shard does NOT earn the VMEM rate (VMEM staging is a
+    # compiler choice, never guaranteed), so the sharded rate floors at
+    # the fast-HBM tier.
+    sh_idx = frontier_rows + (out_edges / n_devices) / 8.0
+    sh_ns = max(gather_ns(shard_bytes), GATHER_NS_HBM)
+    compute = sh_idx * sh_ns * 1e-9
+    # all_gather of the per-shard output: ring moves (D-1)/D of the
+    # payload over each link; 4 bytes per edge slot
+    payload = out_edges * 4.0
+    collective = (
+        ICI_LAT_S + payload * (n_devices - 1) / n_devices / ICI_BW_BYTES_PER_S
+    )
+    return CrossoverEstimate(
+        single, compute + collective + SHARD_DISPATCH_S, forced
+    )
+
+
+def should_shard(
+    arena_bytes: int,
+    n_rows: int,
+    avg_degree: float,
+    n_devices: int,
+    typical_frontier: int = 4096,
+) -> bool:
+    """The `use_mesh_for` decision for one arena: model the TYPICAL query
+    (a frontier of ~4k rows expanding once) and shard when the model says
+    sharded wins — or when single-chip residency is impossible."""
+    f = min(typical_frontier, max(1, n_rows))
+    est = estimate(
+        arena_bytes,
+        frontier_rows=f,
+        out_edges=int(f * max(1.0, avg_degree)),
+        n_devices=n_devices,
+        # one predicate cannot monopolize the chip: arenas for every hot
+        # predicate, value/index tables and program outputs share HBM, so
+        # a single arena above ~40% of it must shard to stay resident
+        hbm_budget_frac=0.4,
+    )
+    return est.shard_wins
