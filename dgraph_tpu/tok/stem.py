@@ -278,6 +278,113 @@ def _stem_nl(w: str) -> str:
     return w
 
 
+_RU_V = "аеиоуыэюяё"
+
+
+def _ru_fold(sufs):
+    """tok._normalize folds й→и (NFKD strips the combining breve), so
+    suffix lists must live in the FOLDED alphabet or they never match."""
+    return tuple(s.replace("й", "и") for s in sufs)
+
+
+def _stem_ru(w: str) -> str:
+    """Light Snowball Russian over Cyrillic (tok._normalize lowercases
+    and folds й→и via NFKD, symmetrically at index and query time).
+    Suffix classes in Snowball's order — adjectival, verbal, noun — each
+    gated on R1, then the residual -и/-ь/-нн cleanups."""
+    r1 = _r1(w, _RU_V)
+
+    def strip_class(word, sufs):
+        for suf in _ru_fold(sufs):
+            if word.endswith(suf) and len(word) - len(suf) >= max(r1, 2):
+                return word[: -len(suf)], True
+        return word, False
+
+    w, hit = strip_class(w, (
+        "ейшими", "ейшего", "ейшему", "ейшая", "ейшее", "ейших", "ейший",
+        "ующими", "ившись", "ывшись", "авшись",
+        "ующая", "ующее", "ующий", "ующих",
+        "иями", "ями", "ами", "ыми", "ими", "его", "ого", "ему", "ому",
+        "ее", "ие", "ые", "ое", "ей", "ий", "ый", "ой", "ем", "им", "ым",
+        "ом", "их", "ых", "ую", "юю", "ая", "яя", "ою", "ею",
+    ))
+    if not hit:
+        w, hit = strip_class(w, (
+            "уйте", "ейте", "ила", "ыла", "ена", "ите", "или", "ыли",
+            "ило", "ыло", "ено", "ует", "уют", "ить", "ыть", "ишь", "ете",
+            "йте", "ены", "нно", "ешь", "ть", "ет", "ют", "ны", "ло",
+            "но", "ла", "на", "ли", "ем", "ил", "ыл", "им", "ым", "ен",
+            "ят", "ит", "ыт", "уй", "ей", "ую", "й", "л", "н", "ю",
+        ))
+    if not hit:
+        w, _ = strip_class(w, (
+            "иями", "иях", "ией", "иям", "ием", "ями", "ами", "ях", "ам",
+            "ем", "ей", "ём", "ой", "ий", "ию", "ью", "ия", "ья", "ев",
+            "ов", "ие", "ье", "еи", "ии", "и", "ы", "ь", "ю", "я", "а",
+            "е", "о", "у", "й",
+        ))
+    for suf in ("ость", "ост"):
+        if w.endswith(suf) and len(w) - len(suf) >= max(r1, 2):
+            w = w[: -len(suf)]
+            break
+    if w.endswith("и") and len(w) - 1 >= max(r1, 2):
+        w = w[:-1]
+    if w.endswith("нн") and len(w) - 1 >= max(r1, 2):
+        w = w[:-1]
+    if w.endswith("ь") and len(w) - 1 >= max(r1, 2):
+        w = w[:-1]
+    return w
+
+
+def _scand_stemmer(extra_sufs):
+    """Shared light Snowball for the Scandinavian trio: one suffix pass
+    in R1 (min 3), then the residual -s after a valid consonant.
+    ø and æ have no NFKD decomposition (unlike å/ä/ö, which fold to
+    a/a/o upstream), so they stay distinct letters and must count as
+    vowels here."""
+    def f(w: str) -> str:
+        V = "aeiouyøæ"
+        r1 = _r1(w, V, 3)
+        for suf in extra_sufs:
+            if w.endswith(suf) and len(w) - len(suf) >= r1:
+                w = w[: -len(suf)]
+                return f2(w, r1)
+        return f2(w, r1)
+
+    def f2(w, r1):
+        if (
+            w.endswith("s")
+            and len(w) - 1 >= r1
+            and len(w) >= 2
+            and w[-2] in "bcdfghjklmnoprtvyz"
+        ):
+            w = w[:-1]
+        if w.endswith("ert") and len(w) - 3 >= r1:
+            w = w[:-3]
+        return w
+
+    return f
+
+
+_stem_sv = _scand_stemmer((
+    "heterna", "hetens", "heten", "heter", "arnas", "ernas", "ornas",
+    "andes", "andet", "arens", "arna", "erna", "orna", "ande", "arne",
+    "aste", "aren", "ades", "erns", "ade", "are", "ern", "ens", "het",
+    "ast", "ad", "en", "ar", "er", "or", "at", "a", "e",
+))
+_stem_da = _scand_stemmer((
+    "erendes", "erende", "heders", "ethed", "erede", "heden", "heder",
+    "endes", "ernes", "erens", "erets", "ered", "ende", "erne", "eren",
+    "erer", "eret", "hed", "ene", "ere", "ens", "ers", "ets", "en",
+    "er", "es", "et", "e",
+))
+_stem_no = _scand_stemmer((
+    "hetenes", "hetens", "hetene", "endes", "heten", "heter", "edes",
+    "enes", "ande", "ende", "edes", "ene", "ane", "ede", "ens", "ers",
+    "ets", "het", "ast", "en", "ar", "er", "as", "es", "et", "a", "e",
+))
+
+
 _STEMMERS = {
     "de": _stem_de,
     "fr": _stem_fr,
@@ -285,11 +392,18 @@ _STEMMERS = {
     "it": _stem_it,
     "pt": _stem_pt,
     "nl": _stem_nl,
+    "ru": _stem_ru,
+    "sv": _stem_sv,
+    "da": _stem_da,
+    "no": _stem_no,
+    "nb": _stem_no,  # Bokmål tag maps to the Norwegian stemmer
 }
 
 # languages with a real stemmer + stopword list (PARITY: the reference
 # ships every snowball language via bleve; we document this set)
-SUPPORTED_LANGS = ("en", "de", "fr", "es", "it", "pt", "nl")
+SUPPORTED_LANGS = (
+    "en", "de", "fr", "es", "it", "pt", "nl", "ru", "sv", "da", "no",
+)
 
 
 def stem(word: str, lang: str = "en") -> str:

@@ -34,4 +34,20 @@ STOPWORDS = {
         """de het een en of maar niet met van te in op voor is zijn was
         waren als ook aan bij naar over om uit dit dat deze die""".split()
     ),
+    "ru": frozenset(
+        """и в во не что он на я с со как а то все она так его но да ты к
+        у же вы за бы по ее мне было вот от меня еще нет о из ему""".split()
+    ),
+    "sv": frozenset(
+        """och det att i en jag hon som han på den med var sig för så
+        till är men ett om hade de av icke mig du henne då sin nu""".split()
+    ),
+    "da": frozenset(
+        """og i jeg det at en den til er som på de med han af for ikke
+        der var mig sig men et har om vi min havde ham hun nu""".split()
+    ),
+    "no": frozenset(
+        """og i jeg det at en et den til er som på de med han av ikke
+        der så var meg seg men ett har om vi min mitt ha hadde hun nå""".split()
+    ),
 }

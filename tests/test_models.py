@@ -259,6 +259,37 @@ def test_fulltext_it_pt_nl_inflections():
     )
 
 
+def test_fulltext_ru_sv_da_no_inflections():
+    """Russian (Cyrillic, й→и NFKD-folded) + the Scandinavian trio
+    (ø/æ counted as vowels — they have no NFKD decomposition)."""
+    from dgraph_tpu import tok
+
+    # Russian: noun plurals, adjective gender, verb infinitive/3sg
+    assert tok.fulltext_tokens("песни", "ru") == tok.fulltext_tokens("песня", "ru")
+    assert tok.fulltext_tokens("книги", "ru") == tok.fulltext_tokens("книга", "ru")
+    assert tok.fulltext_tokens("красивый", "ru") == tok.fulltext_tokens(
+        "красивая", "ru"
+    )
+    assert tok.fulltext_tokens("работает", "ru") == tok.fulltext_tokens(
+        "работать", "ru"
+    )
+    # Swedish definite plurals
+    assert tok.fulltext_tokens("flickorna", "sv") == tok.fulltext_tokens(
+        "flicka", "sv"
+    )
+    assert tok.fulltext_tokens("hundarna", "sv") == tok.fulltext_tokens("hund", "sv")
+    # Danish: ø survives normalization and gates R1 as a vowel
+    assert tok.fulltext_tokens("bøgerne", "da") == tok.fulltext_tokens("bøger", "da")
+    assert tok.fulltext_tokens("husene", "da") == tok.fulltext_tokens("huset", "da")
+    # Norwegian (+ nb alias)
+    assert tok.fulltext_tokens("hestene", "no") == tok.fulltext_tokens("hest", "no")
+    assert tok.fulltext_tokens("hestene", "nb") == tok.fulltext_tokens("hest", "no")
+    # Russian stopwords apply under ru only
+    assert tok.fulltext_tokens("он работает", "ru") == tok.fulltext_tokens(
+        "работает", "ru"
+    )
+
+
 def test_alloftext_lang_matches_inflections():
     """alloftext(name@de, ...) matches German inflections end-to-end: the
     index analyzes each value under ITS lang tag, the query under the
