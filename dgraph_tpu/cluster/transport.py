@@ -273,3 +273,78 @@ class HttpRaftTransport(Transport):
 
     def stop(self) -> None:
         self._stop.set()
+
+
+class GrpcRaftTransport(Transport):
+    """Ships raft frames over the gRPC Worker plane
+    (``/protos.Worker/RaftMessage``, serve/grpc_server.py) — the direct
+    analog of the reference's raft gRPC leg (worker/draft.go:1017).
+    Same queue-per-peer / drop-don't-block discipline as the HTTP
+    transport; channels come from the shared refcounted pool and the
+    cluster secret rides gRPC metadata instead of a header."""
+
+    def __init__(
+        self,
+        addr_of: Dict[str, str],  # node_id -> host:port (gRPC listener)
+        timeout: float = 2.0,
+        secret: str = "",
+    ):
+        from dgraph_tpu.serve.grpc_server import ChannelPool
+
+        self.addr_of = dict(addr_of)
+        self.timeout = timeout
+        self.secret = secret
+        self._pool = ChannelPool()
+        self._queues: Dict[str, "queue.Queue"] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def _queue_for(self, peer: str) -> "queue.Queue":
+        with self._lock:
+            q = self._queues.get(peer)
+            if q is None:
+                q = queue.Queue(maxsize=256)
+                self._queues[peer] = q
+                t = threading.Thread(
+                    target=self._sender, args=(peer, q),
+                    name=f"raft-grpc-send-{peer}", daemon=True,
+                )
+                t.start()
+            return q
+
+    def send(self, to: str, group: int, msg) -> None:
+        if to not in self.addr_of:
+            return
+        try:
+            self._queue_for(to).put_nowait((group, encode_msg(msg)))
+        except queue.Full:
+            pass  # drop: raft retries via next heartbeat
+
+    def _sender(self, peer: str, q: "queue.Queue") -> None:
+        from dgraph_tpu.serve.grpc_server import (
+            _SECRET_MD,
+            encode_payload,
+            frame_raft,
+        )
+
+        target = self.addr_of[peer]
+        chan = self._pool.get(target)
+        rpc = chan.unary_unary("/protos.Worker/RaftMessage")
+        md = [(_SECRET_MD, self.secret)] if self.secret else None
+        while not self._stop.is_set():
+            try:
+                group, body = q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                rpc(
+                    encode_payload(frame_raft(group, body)),
+                    timeout=self.timeout,
+                    metadata=md,
+                )
+            except Exception:
+                pass  # peer down: drop, heartbeats will retry
+        self._pool.release(target)
+
+    def stop(self) -> None:
+        self._stop.set()

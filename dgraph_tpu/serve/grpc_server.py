@@ -326,6 +326,42 @@ def decode_num(b: bytes) -> int:
     return 0
 
 
+# -------------------------------------------------- Worker plane helpers
+
+_SECRET_MD = "x-dgraph-cluster-secret"  # gRPC metadata key (lowercase)
+
+
+def encode_payload(data: bytes) -> bytes:
+    """protos.Payload{Data=1} (payload.proto:9)."""
+    return _p._len_field(1, data)
+
+
+def decode_payload(b: bytes) -> bytes:
+    for f, _w, v in _p.iter_fields(b):
+        if f == 1:
+            return v
+    return b""
+
+
+def frame_raft(group: int, frame: bytes) -> bytes:
+    """Payload.Data for RaftMessage: varint group id + the binary raft
+    frame (cluster/transport.py codec).  The reference routes group via
+    RaftContext inside the payload (worker/draft.go:1017); a leading
+    varint carries the same information without re-parsing the frame."""
+    out = bytearray()
+    from dgraph_tpu.models import codec as _codec
+
+    _codec.put_uvarint(out, group)
+    return bytes(out) + frame
+
+
+def unframe_raft(data: bytes):
+    from dgraph_tpu.models import codec as _codec
+
+    group, pos = _codec.uvarint(data, 0)
+    return int(group), data[pos:]
+
+
 # ----------------------------------------------------------- the server
 
 
@@ -364,6 +400,11 @@ class GrpcServer:
                     return grpc.unary_unary_rpc_method_handler(svc._check)
                 if m == "/protos.Dgraph/AssignUids":
                     return grpc.unary_unary_rpc_method_handler(svc._assign)
+                # Worker plane (payload.proto:28): the intra-cluster RPCs
+                if m == "/protos.Worker/Echo":
+                    return grpc.unary_unary_rpc_method_handler(svc._echo)
+                if m == "/protos.Worker/RaftMessage":
+                    return grpc.unary_unary_rpc_method_handler(svc._raft)
                 return None
 
         self._grpc = grpc.server(
@@ -415,6 +456,37 @@ class GrpcServer:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "Num.val must be > 0")
         uids = self._server.store.uids.fresh(n)
         return encode_assigned_ids(uids[0], uids[-1])
+
+    # -- Worker plane (the reference's internal gRPC port) ----------------
+
+    def _echo(self, req: bytes, context):
+        # conn.go:108 Echo: payload round-trip, no auth needed (liveness)
+        return req
+
+    def _cluster_ok(self, context) -> bool:
+        cluster = getattr(self._server, "cluster", None)
+        if cluster is None:
+            return False
+        secret = getattr(getattr(cluster, "auth", None), "secret", "")
+        if not secret:
+            return True
+        md = dict(context.invocation_metadata())
+        return md.get(_SECRET_MD, "") == secret
+
+    def _raft(self, req: bytes, context):
+        import grpc
+
+        cluster = getattr(self._server, "cluster", None)
+        if cluster is None:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED, "not clustered")
+        if not self._cluster_ok(context):
+            context.abort(grpc.StatusCode.PERMISSION_DENIED, "bad cluster secret")
+        try:
+            group, frame = unframe_raft(decode_payload(req))
+            cluster.deliver(group, frame)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return encode_payload(b"")
 
 
 # ----------------------------------------------------------- client pool
