@@ -521,6 +521,12 @@ class _Parser:
                     raise ParseError("trailing comma in @facets")
             first = False
             t = self.peek()
+            if t.kind == "punct" and t.text == "(":
+                # parenthesized filter tree: @facets((eq(a,1) or eq(b,2))
+                # and ge(c,3)) — the reference's parseFilter admits a
+                # leading group the same way
+                gq.facets_filter = self._parse_filter_or()
+                break
             if t.kind == "name" and t.text in ("orderasc", "orderdesc") and self.peek(1).text == ":":
                 self.next()
                 self.expect("punct", ":")

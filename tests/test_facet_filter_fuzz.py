@@ -1,7 +1,12 @@
 """Randomized equivalence: the vectorized facet-filter evaluation
 (engine._apply_facet_filter's boolean-column compiler, VERDICT r4 weak
 #4) must match a direct per-edge evaluation of the same tree on graphs
-with mixed-type, partially-missing facets."""
+with mixed-type, partially-missing facets — including keys whose value
+TYPE differs edge to edge (the per-tid grouping path), filter args that
+fail conversion for some tids, and nested composite trees."""
+
+import operator
+import re
 
 import numpy as np
 import pytest
@@ -9,10 +14,18 @@ import pytest
 from dgraph_tpu.models import PostingStore
 from dgraph_tpu.query import QueryEngine
 
+_LEAF_RE = re.compile(r"(eq|lt|le|gt|ge)\((\w+), ?([\w.]+)\)")
+_OPS = {
+    "eq": operator.eq, "lt": operator.lt, "le": operator.le,
+    "gt": operator.gt, "ge": operator.ge,
+}
+
 
 def _build(rng, n_kids=40):
     """One parent with n_kids edges; each edge gets a random subset of
-    facets with heterogeneous types (ints, floats, strings, bools)."""
+    facets.  Key "w" is MIXED-TYPE by design: some edges carry it as an
+    int, others as a string (facet sniffing types each edge on its own),
+    so one leaf spans several tid groups in the vectorized compiler."""
     lines = []
     expected = {}
     for i in range(n_kids):
@@ -20,7 +33,10 @@ def _build(rng, n_kids=40):
         facets = []
         truth = {}
         if rng.random() < 0.8:
-            v = int(rng.integers(0, 6))
+            if rng.random() < 0.3:
+                v = ["abc", "zz"][int(rng.integers(0, 2))]
+            else:
+                v = int(rng.integers(0, 6))
             facets.append(f"w={v}")
             truth["w"] = v
         if rng.random() < 0.5:
@@ -42,13 +58,11 @@ def _build(rng, n_kids=40):
     return "\n".join(lines), expected
 
 
-def _scalar_eval(tree_txt, facets):
-    """Direct evaluation of one filter expression on one edge's facets —
-    the pre-vectorization semantics, written independently."""
-    import re
-
-    m = re.fullmatch(r"(eq|lt|le|gt|ge)\((\w+), ?([\w.]+)\)", tree_txt)
-    op, key, arg = m.groups()
+def _scalar_leaf(leaf, facets):
+    """Direct evaluation of one leaf on one edge's facets — the
+    pre-vectorization semantics (convert arg to the FACET's type, False
+    on conversion failure), written independently of the engine."""
+    op, key, arg = _LEAF_RE.fullmatch(leaf).groups()
     if key not in facets:
         return False
     fv = facets[key]
@@ -58,22 +72,60 @@ def _scalar_eval(tree_txt, facets):
         tv = arg == "true"
     elif isinstance(fv, (int, float)):
         try:
-            tv = type(fv)(float(arg)) if isinstance(fv, float) else int(arg)
+            tv = float(arg) if isinstance(fv, float) else int(arg)
         except ValueError:
-            return False
+            return False  # convert failure -> leaf is False for this tid
     else:
         tv = arg
-    import operator
+    return _OPS[op](fv, tv)
 
-    return {
-        "eq": operator.eq, "lt": operator.lt, "le": operator.le,
-        "gt": operator.gt, "ge": operator.ge,
-    }[op](fv, tv)
+
+def _scalar_eval(expr, facets):
+    """Recursive oracle over the unambiguous forms the generator emits:
+    leaves, 'not X', binary 'A and B' / 'A or B', and parenthesized
+    nests '(A op B) op C' (split on the TOP-LEVEL connective only)."""
+    expr = expr.strip()
+    if expr.startswith("(") and expr.endswith(")") and _balanced(expr[1:-1]):
+        return _scalar_eval(expr[1:-1], facets)
+    if expr.startswith("not "):
+        return not _scalar_eval(expr[4:], facets)
+    for conn, fn in ((" and ", all), (" or ", any)):
+        parts = _split_top(expr, conn)
+        if len(parts) > 1:
+            return fn(_scalar_eval(p, facets) for p in parts)
+    return _scalar_leaf(expr, facets)
+
+
+def _balanced(s):
+    d = 0
+    for c in s:
+        d += (c == "(") - (c == ")")
+        if d < 0:
+            return False
+    return d == 0
+
+
+def _split_top(expr, conn):
+    parts, depth, cur = [], 0, ""
+    i = 0
+    while i < len(expr):
+        if depth == 0 and expr.startswith(conn, i):
+            parts.append(cur)
+            cur = ""
+            i += len(conn)
+            continue
+        depth += (expr[i] == "(") - (expr[i] == ")")
+        cur += expr[i]
+        i += 1
+    parts.append(cur)
+    return parts
 
 
 LEAVES = [
     "eq(w, 3)", "ge(w, 2)", "lt(w, 4)", "le(score, 2.0)", "gt(score, 1.5)",
     "eq(tag, red)", "eq(tag, blue)", "eq(ok, true)", "ge(w, 0)",
+    "eq(w, abc)",   # string arg vs mixed int/str column: int tids fail convert
+    "ge(w, zz)",    # range op on the string tid group
 ]
 
 
@@ -85,12 +137,15 @@ def test_vectorized_facet_filter_matches_scalar(seed):
     eng.run("mutation { schema { rel: uid . name: string . } set { %s } }" % rdf)
 
     exprs = list(LEAVES)
-    # composite trees: and/or/not over random leaf pairs
     for _ in range(6):
-        a, b = rng.choice(LEAVES, size=2, replace=False)
+        a, b, c = rng.choice(LEAVES, size=3, replace=False)
         exprs.append(f"{a} and {b}")
         exprs.append(f"{a} or {b}")
         exprs.append(f"not {a}")
+        # nested composites: the recursive mask algebra, not just depth-1
+        exprs.append(f"({a} and {b}) or {c}")
+        exprs.append(f"not ({a} or {b})")
+        exprs.append(f"({a} or {b}) and not {c}")
 
     for expr in exprs:
         out = eng.run(
@@ -100,17 +155,5 @@ def test_vectorized_facet_filter_matches_scalar(seed):
             int(x["_uid_"], 16)
             for x in (out["q"][0].get("rel", []) if out["q"] else [])
         }
-
-        def ev(e, facets):
-            if e.startswith("not "):
-                return not _scalar_eval(e[4:], facets)
-            if " and " in e:
-                l, r = e.split(" and ")
-                return _scalar_eval(l, facets) and _scalar_eval(r, facets)
-            if " or " in e:
-                l, r = e.split(" or ")
-                return _scalar_eval(l, facets) or _scalar_eval(r, facets)
-            return _scalar_eval(e, facets)
-
-        want = {k for k, f in expected.items() if ev(expr, f)}
+        want = {k for k, f in expected.items() if _scalar_eval(expr, f)}
         assert got == want, f"{expr}: got {sorted(got)} want {sorted(want)}"
