@@ -71,6 +71,10 @@ def build_options(argv=None) -> Options:
                    default=d.peer_tls_insecure,
                    help="explicitly skip peer TLS verification "
                         "(throwaway self-signed clusters only)")
+    p.add_argument("--raft_transport", default=d.raft_transport,
+                   choices=("http", "grpc"),
+                   help="raft frame carrier between servers; grpc uses "
+                        "/protos.Worker/RaftMessage at peer http port+1000")
     p.add_argument("--workers", type=int, default=d.workers)
     p.add_argument("--num_pending", type=int, default=d.num_pending)
     p.add_argument("--max_edges", type=int, default=d.max_edges)
@@ -99,6 +103,33 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", "cpu")
     opts = build_options(argv)
+    # the gRPC listener port this process will bind (0 = http port + 1000)
+    grpc_port = (
+        -1
+        if opts.grpc_port < 0
+        else (opts.grpc_port or (opts.port + 1000 if opts.port else 0))
+    )
+    if opts.raft_transport == "grpc":
+        # fail fast: a node whose raft plane is gRPC but that serves no
+        # gRPC listener (or lacks grpcio) can neither send nor receive
+        # frames — it would boot, never elect, and give no hint why
+        if grpc_port <= 0 or opts.port <= 0:
+            print(
+                "--raft_transport grpc requires explicit --port and an "
+                "enabled gRPC listener (--grpc_port >= 0); peers derive "
+                "each other's raft targets as http port + the same offset",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            import grpc  # noqa: F401
+        except ImportError:
+            print(
+                "--raft_transport grpc requires grpcio, which is not "
+                "importable in this environment",
+                file=sys.stderr,
+            )
+            return 2
     # profiling surface (setupProfiling, cmd/dgraph/main.go:181).  The
     # CPU profile covers QUERY EXECUTION (enabled per-request under the
     # engine lock — cProfile is per-thread, and a main-thread profiler
@@ -129,6 +160,8 @@ def main(argv=None) -> int:
             secret=opts.cluster_secret,
             peer_ca=opts.peer_ca,
             peer_tls_insecure=opts.peer_tls_insecure,
+            raft_transport=opts.raft_transport,
+            grpc_port_offset=max(0, grpc_port - opts.port),
             passive=True,
         )
         cluster.start()
@@ -155,6 +188,8 @@ def main(argv=None) -> int:
             secret=opts.cluster_secret,
             peer_ca=opts.peer_ca,
             peer_tls_insecure=opts.peer_tls_insecure,
+            raft_transport=opts.raft_transport,
+            grpc_port_offset=max(0, grpc_port - opts.port),
             peer_groups=parse_peer_groups(opts.peer_groups),
         )
         has_https_peer = any(
@@ -188,12 +223,11 @@ def main(argv=None) -> int:
     srv.start()
     print(f"dgraph-tpu serving at {srv.addr}  (dashboard at /, queries at /query)")
     grpc_srv = None
-    if opts.grpc_port >= 0:
+    if grpc_port >= 0:
         try:
             from dgraph_tpu.serve.grpc_server import GrpcServer
 
-            gport = opts.grpc_port or (opts.port + 1000 if opts.port else 0)
-            grpc_srv = GrpcServer(srv, bind=opts.bind, port=gport)
+            grpc_srv = GrpcServer(srv, bind=opts.bind, port=grpc_port)
             grpc_srv.start()
             print(f"gRPC (protos.Dgraph) at {opts.bind}:{grpc_srv.port}")
         except ImportError:

@@ -97,6 +97,8 @@ class ClusterService:
         peer_ca: str = "",
         peer_tls_insecure: bool = False,
         peer_groups: Optional[Dict[str, List[int]]] = None,
+        raft_transport: str = "http",
+        grpc_port_offset: int = 1000,
         **raft_opts,
     ):
         if METADATA_GROUP not in group_ids:
@@ -113,10 +115,23 @@ class ClusterService:
         else:
             self.conf = GroupConfig.single_group()
         self.auth = PeerAuth(secret=secret, cafile=peer_ca, insecure=peer_tls_insecure)
-        self.transport = HttpRaftTransport(
-            {nid: a for nid, a in self.peers.items() if nid != node_id},
-            auth=self.auth,
-        )
+        others = {nid: a for nid, a in self.peers.items() if nid != node_id}
+        if raft_transport == "grpc":
+            # raft frames over the gRPC Worker plane (the reference's
+            # native raft leg, draft.go:1017).  gRPC listeners sit at the
+            # http port + offset (the CLI's --grpc_port convention); the
+            # transport derives targets per message, so members learned
+            # or re-addressed at runtime route correctly too.
+            from dgraph_tpu.cluster.transport import GrpcRaftTransport
+
+            self.transport = GrpcRaftTransport(
+                others,
+                secret=secret,
+                port_offset=grpc_port_offset,
+                auth=self.auth,
+            )
+        else:
+            self.transport = HttpRaftTransport(others, auth=self.auth)
         # static placement (group/conf.go's server-side complement): which
         # groups each peer serves.  None/missing peer = serves everything
         # (full replication, the pre-placement behavior).  The metadata
@@ -228,7 +243,22 @@ class ClusterService:
         iterate self.peers/addr_of concurrently."""
         if nid != self.node_id:
             self.peers = {**self.peers, nid: addr}
-            self.transport.addr_of = {**self.transport.addr_of, nid: addr}
+            # transport-agnostic rewiring: the gRPC transport derives its
+            # target from the http address itself (update_peer validates).
+            # Validation failures must NOT raise: this runs on the raft
+            # apply thread, and aborting would leave the committed batch
+            # partially applied on this replica — skip the rewiring (the
+            # peer stays unreachable, which is true) and log instead.
+            try:
+                self.transport.update_peer(nid, addr)
+            except ValueError as e:
+                import sys as _sys
+
+                print(
+                    f"warning: cannot route raft frames to member {nid} "
+                    f"at {addr!r}: {e}",
+                    file=_sys.stderr,
+                )
         member_groups = set(groups) if groups else None
         if member_groups is not None:
             self.peer_groups = {

@@ -247,6 +247,11 @@ class HttpRaftTransport(Transport):
                 t.start()
             return q
 
+    def update_peer(self, nid: str, addr: str) -> None:
+        """Runtime membership rewiring (atomic reference swap — sender
+        threads re-read addr_of per message)."""
+        self.addr_of = {**self.addr_of, nid: addr}
+
     def send(self, to: str, group: int, msg) -> None:
         if to not in self.addr_of:
             return
@@ -275,29 +280,103 @@ class HttpRaftTransport(Transport):
         self._stop.set()
 
 
+def grpc_target_of(http_addr: str, port_offset: int) -> str:
+    """Peer address → its gRPC target.  Accepts full http(s)://host:port
+    urls AND bare host:port (ClusterService's peers param admits both);
+    raises on anything it cannot map rather than emitting a target that
+    silently drops every frame."""
+    from urllib.parse import urlsplit
+
+    addr = http_addr
+    if "://" not in addr:
+        addr = "http://" + addr
+    u = urlsplit(addr)
+    if not u.hostname or not u.port:
+        raise ValueError(f"cannot derive a gRPC target from peer address {http_addr!r}")
+    return f"{u.hostname}:{u.port + port_offset}"
+
+
 class GrpcRaftTransport(Transport):
     """Ships raft frames over the gRPC Worker plane
     (``/protos.Worker/RaftMessage``, serve/grpc_server.py) — the direct
     analog of the reference's raft gRPC leg (worker/draft.go:1017).
     Same queue-per-peer / drop-don't-block discipline as the HTTP
-    transport; channels come from the shared refcounted pool and the
-    cluster secret rides gRPC metadata instead of a header."""
+    transport; the cluster secret rides gRPC metadata.
+
+    ``addr_of`` holds peer HTTP addresses (same contract as
+    HttpRaftTransport, so runtime membership rewiring via update_peer is
+    transport-agnostic); targets derive per message, so a member that
+    re-announces on a new address is picked up by the live sender.
+    https peers require ``auth.cafile`` — gRPC channels are TLS-verified
+    with the pinned CA; there is no silent plaintext downgrade."""
 
     def __init__(
         self,
-        addr_of: Dict[str, str],  # node_id -> host:port (gRPC listener)
+        addr_of: Dict[str, str],  # node_id -> http(s)://host:port
         timeout: float = 2.0,
         secret: str = "",
+        port_offset: int = 1000,
+        auth: Optional[PeerAuth] = None,
     ):
-        from dgraph_tpu.serve.grpc_server import ChannelPool
-
         self.addr_of = dict(addr_of)
         self.timeout = timeout
         self.secret = secret
-        self._pool = ChannelPool()
+        self.port_offset = port_offset
+        self.auth = auth
+        for a in self.addr_of.values():
+            self._check_addr(a)
         self._queues: Dict[str, "queue.Queue"] = {}
+        self._chans: Dict[str, object] = {}  # target -> channel
         self._lock = threading.Lock()
         self._stop = threading.Event()
+
+    def _check_addr(self, addr: str) -> None:
+        grpc_target_of(addr, self.port_offset)  # raises if unmappable
+        if addr.startswith("https://") and not (self.auth and self.auth.cafile):
+            raise ValueError(
+                "https peers over the gRPC raft transport require a pinned "
+                "CA (--peer_ca): gRPC has no unverified-TLS mode and "
+                "silently downgrading raft frames to plaintext would leak "
+                "the cluster secret"
+            )
+
+    def update_peer(self, nid: str, addr: str) -> None:
+        self._check_addr(addr)
+        old = self.addr_of.get(nid)
+        self.addr_of = {**self.addr_of, nid: addr}
+        if old and old != addr:
+            # close the superseded channel unless another peer still maps
+            # to the same target — re-addressing members must not leak
+            # one open HTTP/2 connection per churn for the process life
+            old_t = grpc_target_of(old, self.port_offset)
+            live = {
+                grpc_target_of(a, self.port_offset)
+                for a in self.addr_of.values()
+            }
+            if old_t not in live:
+                with self._lock:
+                    ch = self._chans.pop(old_t, None)
+                if ch is not None:
+                    try:
+                        ch.close()
+                    except Exception:
+                        pass
+
+    def _channel_for(self, addr: str):
+        import grpc
+
+        target = grpc_target_of(addr, self.port_offset)
+        with self._lock:
+            ch = self._chans.get(target)
+            if ch is None:
+                if addr.startswith("https://"):
+                    with open(self.auth.cafile, "rb") as f:
+                        creds = grpc.ssl_channel_credentials(f.read())
+                    ch = grpc.secure_channel(target, creds)
+                else:
+                    ch = grpc.insecure_channel(target)
+                self._chans[target] = ch
+            return ch
 
     def _queue_for(self, peer: str) -> "queue.Queue":
         with self._lock:
@@ -327,16 +406,25 @@ class GrpcRaftTransport(Transport):
             frame_raft,
         )
 
-        target = self.addr_of[peer]
-        chan = self._pool.get(target)
-        rpc = chan.unary_unary("/protos.Worker/RaftMessage")
         md = [(_SECRET_MD, self.secret)] if self.secret else None
+        cur_addr = None
+        rpc = None
         while not self._stop.is_set():
             try:
                 group, body = q.get(timeout=0.5)
             except queue.Empty:
                 continue
             try:
+                # re-resolve per message (like HttpRaftTransport): a
+                # member re-announcing on a new address rebinds the rpc
+                addr = self.addr_of.get(peer)
+                if addr is None:
+                    continue
+                if addr != cur_addr or rpc is None:
+                    rpc = self._channel_for(addr).unary_unary(
+                        "/protos.Worker/RaftMessage"
+                    )
+                    cur_addr = addr
                 rpc(
                     encode_payload(frame_raft(group, body)),
                     timeout=self.timeout,
@@ -344,7 +432,13 @@ class GrpcRaftTransport(Transport):
                 )
             except Exception:
                 pass  # peer down: drop, heartbeats will retry
-        self._pool.release(target)
 
     def stop(self) -> None:
         self._stop.set()
+        with self._lock:
+            for ch in self._chans.values():
+                try:
+                    ch.close()
+                except Exception:
+                    pass
+            self._chans.clear()

@@ -414,7 +414,25 @@ class GrpcServer:
             )
         )
         self._grpc.add_generic_rpc_handlers((_Handler(),))
-        self._port = self._grpc.add_insecure_port(f"{self._bind}:{self._port}")
+        # TLS follows the HTTP surface: a server started with --tls_cert
+        # serves gRPC over TLS too — otherwise an https cluster running
+        # --raft_transport grpc would dial TLS into a plaintext listener
+        # and the raft plane would be silently dead
+        cert = getattr(self._server, "_tls_cert", "")
+        key = getattr(self._server, "_tls_key", "")
+        if cert:
+            with open(cert, "rb") as f:
+                chain = f.read()
+            kb = chain
+            if key:
+                with open(key, "rb") as f:
+                    kb = f.read()
+            creds = grpc.ssl_server_credentials(((kb, chain),))
+            self._port = self._grpc.add_secure_port(
+                f"{self._bind}:{self._port}", creds
+            )
+        else:
+            self._port = self._grpc.add_insecure_port(f"{self._bind}:{self._port}")
         self._grpc.start()
 
     def stop(self, grace: float = 0.5) -> None:

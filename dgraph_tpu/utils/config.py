@@ -45,6 +45,10 @@ class Options:
     cluster_secret: str = ""
     peer_ca: str = ""
     peer_tls_insecure: bool = False
+    # raft plane carrier: "http" (binary frames over POST /raft/<g>) or
+    # "grpc" (/protos.Worker/RaftMessage — the reference's native leg;
+    # requires peers to serve gRPC at http port + 1000)
+    raft_transport: str = "http"
     # observability
     trace_ratio: float = 0.0
     expose_trace: bool = False
