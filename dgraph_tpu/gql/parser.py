@@ -864,68 +864,91 @@ def _parse_uid(s: str) -> int:
     raise ParseError(f"invalid uid {s!r}")
 
 
+# Brace matching over big mutation bodies is a bulk-load hot path: any
+# scheme that visits every token pays ~3 Python iterations per RDF line
+# (two IRIs + a literal).  Braces themselves are RARE — section headers
+# plus the odd quoted brace — so the matcher seeks candidate braces with
+# C-level str.find and tokenizes ONLY the lines containing them (string
+# literals, IRIs and comments never span lines, matching the reference's
+# single-line lexer tokens; gql/state.go errors on unclosed strings).
+_LINE_TOK_RE = re.compile(
+    r'"(?:\\.|[^"\\\n])*(?:"|$)'  # string literal, line-bounded
+    r"|<[^>\n]*>"                 # IRI
+    r"|#[^\n]*"                   # comment
+    r"|[{}]",
+    re.MULTILINE,
+)
+
+
 def _match_brace(text: str, open_idx: int) -> int:
-    """Index of the '}' matching text[open_idx] == '{' (string/comment aware)."""
-    depth = 0
-    i = open_idx
+    """Index of the '}' matching text[open_idx] == '{' (string/comment/
+    IRI aware)."""
+    depth = 1
+    pos = open_idx + 1
     n = len(text)
-    while i < n:
-        c = text[i]
-        if c == '"':
-            i += 1
-            while i < n and text[i] != '"':
-                i += 2 if text[i] == "\\" else 1
-        elif c == "#":
-            while i < n and text[i] != "\n":
-                i += 1
-        elif c == "<":  # IRI — may contain braces? keep simple: skip to '>'
-            j = text.find(">", i + 1)
-            if j != -1 and "\n" not in text[i:j]:
-                i = j
-        elif c == "{":
-            depth += 1
-        elif c == "}":
-            depth -= 1
-            if depth == 0:
-                return i
-        i += 1
+    # candidates memoize across iterations (refreshed only once passed):
+    # re-finding both per loop would go quadratic on bodies dense in one
+    # brace kind, e.g. literals full of '{' with a distant final '}'
+    jo = jc = -2
+    while pos < n:
+        if -1 < jo < pos or jo == -2:
+            jo = text.find("{", pos)
+        if -1 < jc < pos or jc == -2:
+            jc = text.find("}", pos)
+        if jc == -1 and jo == -1:
+            break
+        cand = min(x for x in (jo, jc) if x != -1)
+        # tokenize just this candidate's line (from the later of line
+        # start / the char after the open brace — both token boundaries)
+        ls = text.rfind("\n", 0, cand) + 1
+        le = text.find("\n", cand)
+        le = n if le == -1 else le
+        for m in _LINE_TOK_RE.finditer(text, max(ls, open_idx + 1), le):
+            c = text[m.start()]
+            if c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+                if depth == 0:
+                    return m.start()
+        pos = le + 1
     raise ParseError("unbalanced braces")
 
 
-_SECTION_RE = re.compile(r"\b(set|delete|del|schema)\s*\{")
 _REGEXP_ARG_RE = re.compile(
     r"(regexp\s*\(\s*[^,()]+?,\s*)/((?:\\.|[^/\\\n])*)/([a-z]*)"
+)
+
+
+_MUT_TOK_RE = re.compile(
+    r'"(?:\\.|[^"\\])*(?:"|\Z)|#[^\n]*|[{}]|mutation'
 )
 
 
 def _find_toplevel_mutation(text: str) -> Optional[re.Match]:
     """Find 'mutation {' at brace depth 0, outside strings/comments —
     a regex search alone would match inside string literals or a
-    predicate subtree named 'mutation'."""
+    predicate subtree named 'mutation'.  Tokenized like _match_brace
+    (per-character walking is too slow for bulk bodies); string and
+    comment tokens fall through untouched."""
     depth = 0
-    i, n = 0, len(text)
-    while i < n:
+    n = len(text)
+    for m in _MUT_TOK_RE.finditer(text):
+        i = m.start()
         c = text[i]
-        if c == '"':
-            i += 1
-            while i < n and text[i] != '"':
-                i += 2 if text[i] == "\\" else 1
-        elif c == "#":
-            while i < n and text[i] != "\n":
-                i += 1
-        elif c == "{":
+        if c == "{":
             depth += 1
         elif c == "}":
             depth -= 1
-        elif depth == 0 and text.startswith("mutation", i) and (
-            i == 0 or not (text[i - 1].isalnum() or text[i - 1] in "_.")
-        ):
-            j = i + len("mutation")
-            while j < n and text[j].isspace():
-                j += 1
-            if j < n and text[j] == "{":
-                return _FakeMatch(i, j)
-        i += 1
+        elif c == "m":  # the literal 'mutation'
+            if depth == 0 and (
+                i == 0 or not (text[i - 1].isalnum() or text[i - 1] in "_.")
+            ):
+                j = m.end()
+                while j < n and text[j].isspace():
+                    j += 1
+                if j < n and text[j] == "{":
+                    return _FakeMatch(i, j)
     return None
 
 
@@ -939,26 +962,45 @@ class _FakeMatch:
         return self._start
 
 
+_SECTION_AT_RE = re.compile(r"(set|delete|del|schema)\s*\{")
+
+
 def _extract_mutation(text: str) -> Tuple[str, Optional[Mutation]]:
     """Cut the top-level ``mutation { set {...} delete {...} schema {...} }``
     out of the request text before lexing — N-Quad bodies are not lexable
-    as query tokens (they contain bare '.', '^^', etc.)."""
+    as query tokens (they contain bare '.', '^^', etc.).
+
+    Single forward pass: each section's body is brace-matched exactly
+    once (the earlier outer-then-per-section structure scanned every
+    multi-million-quad set body twice), and anything between sections
+    that is not whitespace/comment is an unknown operation (the
+    reference lexer's "Invalid operation type")."""
     m = _find_toplevel_mutation(text)
     if m is None:
         return text, None
-    open_idx = m.brace
-    close_idx = _match_brace(text, open_idx)
-    body = text[open_idx + 1 : close_idx]
     mu = Mutation()
-    pos = 0
-    spans = []
-    while True:
-        sm = _SECTION_RE.search(body, pos)
-        if sm is None:
+    n = len(text)
+    i = m.brace + 1
+    close_idx = None
+    while i < n:
+        c = text[i]
+        if c in " \t\r\n":
+            i += 1
+            continue
+        if c == "#":  # comment between sections
+            j = text.find("\n", i + 1)
+            i = n if j == -1 else j + 1
+            continue
+        if c == "}":
+            close_idx = i
             break
-        o = body.index("{", sm.start())
-        c = _match_brace(body, o)
-        content = body[o + 1 : c]
+        sm = _SECTION_AT_RE.match(text, i)
+        if sm is None:
+            snippet = text[i : i + 30].split("\n")[0]
+            raise ParseError(f"unknown mutation section near {snippet!r}")
+        o = sm.end() - 1
+        c_idx = _match_brace(text, o)
+        content = text[o + 1 : c_idx]
         kw = sm.group(1)
         if kw == "set":
             mu.set_nquads = content
@@ -966,19 +1008,9 @@ def _extract_mutation(text: str) -> Tuple[str, Optional[Mutation]]:
             mu.del_nquads = content
         else:
             mu.schema = content
-        spans.append((sm.start(), c + 1))
-        pos = c + 1
-    # anything outside the recognized sections is an unknown operation
-    # (the reference lexer errors "Invalid operation type")
-    residue = "".join(
-        body[(0 if i == 0 else spans[i - 1][1]) : s]
-        for i, (s, _e) in enumerate(spans)
-    ) + (body[spans[-1][1] :] if spans else body)
-    residue = re.sub(r"#[^\n]*", "", residue)  # comments between sections
-    if residue.strip():
-        raise ParseError(
-            f"unknown mutation section near {residue.strip()[:30]!r}"
-        )
+        i = c_idx + 1
+    if close_idx is None:
+        raise ParseError("unbalanced braces")
     rest = text[: m.start()] + text[close_idx + 1 :]
     return rest, mu
 

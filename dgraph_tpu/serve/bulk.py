@@ -127,7 +127,9 @@ def fast_apply_set(
             body = _unescape(body)
         tname = types[r.type_idx[i]] if flags[i] & F_HAS_TYPE else ""
         val = typed_literal(body, tname)
-        tid = schema_tid.setdefault(pi, store.schema.type_of(preds[pi]))
+        tid = schema_tid.get(pi)
+        if tid is None:  # NOT setdefault: it would call type_of per line
+            tid = schema_tid[pi] = store.schema.type_of(preds[pi])
         if tid not in (TypeID.DEFAULT, TypeID.UID):
             val = convert(val, tid)
             if tid == TypeID.PASSWORD:

@@ -372,6 +372,42 @@ def test_mutation_blocks():
     assert "@index(term)" in mu.schema
 
 
+def test_mutation_brace_matching_adversarial():
+    """The line-seeking brace matcher (bulk-load hot path) must ignore
+    braces inside string literals, IRIs and comments, and still error on
+    genuinely unbalanced or unknown content."""
+    res = parse(
+        'mutation { set {\n'
+        '  <a> <p> "curly } brace { soup" .\n'
+        '  <a> <q> <http://x/{y}> .\n'
+        '  # comment with } braces {\n'
+        '  <a> <r> "plain" .\n'
+        '} }'
+    )
+    mu = res.mutation
+    assert '"curly } brace { soup"' in mu.set_nquads
+    assert "<http://x/{y}>" in mu.set_nquads
+    assert '"plain"' in mu.set_nquads
+
+    # comments allowed between sections; delete and schema both land
+    res = parse(
+        "mutation { # leading comment\n"
+        "  set { <a> <p> <b> . }\n"
+        "  # between sections }\n"
+        "  delete { <a> <q> <c> . }\n"
+        "  schema { name: string @index(term) . }\n"
+        "}"
+    )
+    assert "<b>" in res.mutation.set_nquads
+    assert "<c>" in res.mutation.del_nquads
+    assert "@index(term)" in res.mutation.schema
+
+    with pytest.raises(ParseError, match="unknown mutation section"):
+        parse("mutation { bogus { <a> <p> <b> . } }")
+    with pytest.raises(ParseError, match="unbalanced"):
+        parse('mutation { set { <a> <p> "unclosed } ')
+
+
 def test_mutation_and_query_together():
     res = parse("""
     mutation { set { <a> <p> <b> . } }
