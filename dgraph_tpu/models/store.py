@@ -300,6 +300,7 @@ class PostingStore:
             return
         p = self.pred(pred)
         self.dirty.add(pred)
+        p._wdmirror = None  # uids-with-data changes under bulk adds too
         self._delta_overflow(pred)  # bulk volume: full rebuild is cheaper
         order = np.argsort(src, kind="stable")
         s = src[order]

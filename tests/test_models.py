@@ -259,6 +259,24 @@ def test_fulltext_it_pt_nl_inflections():
     )
 
 
+def test_wdmirror_invalidated_by_bulk_edges():
+    """The cached uids-with-data mirror (backing the vectorized
+    _predicate_ probe) must not go stale under the BULK ingest path."""
+    import numpy as np
+    from dgraph_tpu.models import PostingStore
+
+    st = PostingStore()
+    st.apply_many([])
+    from dgraph_tpu.models.store import Edge
+
+    st.apply(Edge(pred="p", src=1, dst=2))
+    pd = st.pred("p")
+    assert 1 in pd.uids_with_data_sorted()  # warm the mirror
+    st.bulk_set_uid_edges("p", np.array([7, 8]), np.array([9, 10]))
+    got = pd.uids_with_data_sorted()
+    assert 7 in got and 8 in got  # stale mirror would miss these
+
+
 def test_fulltext_ru_sv_da_no_inflections():
     """Russian (Cyrillic, й→и NFKD-folded) + the Scandinavian trio
     (ø/æ counted as vowels — they have no NFKD decomposition)."""
