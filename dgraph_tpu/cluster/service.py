@@ -869,6 +869,13 @@ class ClusterStore:
             self._owner_gid(pred), [codec.encode_bulk_edges(pred, src, dst)]
         )
 
+    def bulk_set_values(self, pred: str, items) -> None:
+        if not items:
+            return
+        self._svc.propose_records(
+            self._owner_gid(pred), [codec.encode_bulk_values(pred, items)]
+        )
+
     def delete_predicate(self, pred: str) -> None:
         self._svc.propose_records(
             self._owner_gid(pred), [codec.encode_delpred(pred)]

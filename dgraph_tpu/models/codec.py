@@ -34,6 +34,7 @@ LEASE = 0x04
 DELPRED = 0x05
 BULKEDGES = 0x06
 MEMBER = 0x07   # cluster membership: node_id + serving address
+BULKVALS = 0x08  # one record for a predicate group of plain value edges
 
 _F_DEL = 1
 _F_VALUE = 2
@@ -231,6 +232,34 @@ def decode_bulk_edges(b: bytes):
     src = np.frombuffer(b, dtype="<i8", count=n, offset=pos)
     dst = np.frombuffer(b, dtype="<i8", count=n, offset=pos + 8 * n)
     return pred, src, dst
+
+
+def encode_bulk_values(pred: str, items) -> bytes:
+    """One record for a predicate group of plain (facet-less) value
+    edges; ``items`` = [(src, lang, TypedValue)] in INPUT ORDER (repeated
+    writes of one (src, lang) are last-write-wins, so order is part of
+    the record's meaning)."""
+    buf = bytearray([BULKVALS])
+    put_str(buf, pred)
+    put_uvarint(buf, len(items))
+    for src, lang, v in items:
+        put_uvarint(buf, src)
+        put_str(buf, lang)
+        put_value(buf, v)
+    return bytes(buf)
+
+
+def decode_bulk_values(b: bytes):
+    assert b[0] == BULKVALS
+    pred, pos = get_str(b, 1)
+    n, pos = uvarint(b, pos)
+    items = []
+    for _ in range(n):
+        src, pos = uvarint(b, pos)
+        lang, pos = get_str(b, pos)
+        v, pos = get_value(b, pos)
+        items.append((src, lang, v))
+    return pred, items
 
 
 def encode_schema(text: str) -> bytes:

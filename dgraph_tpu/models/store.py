@@ -316,6 +316,34 @@ class PostingStore:
             else:
                 tgt.update(d[b0:b1].tolist())
 
+    def bulk_set_values(self, pred: str, items) -> None:
+        """Vectorized ingest of plain (facet-less) value edges: ONE dict
+        update pass per predicate group instead of an Edge object +
+        apply() dispatch per value.  ``items`` = [(src, lang, TypedValue)]
+        in input order — last-write-wins per (src, lang) is preserved by
+        insertion order.  Semantics identical to apply(set) per edge."""
+        if not items:
+            return
+        p = self.pred(pred)
+        self.dirty.add(pred)
+        p._wdmirror = None
+        self._delta_overflow(pred)  # value/index arenas rebuild
+        vals = p.values
+        any_untagged = any_lang = False
+        for src, lang, v in items:
+            vals[(src, lang)] = v
+            if lang:
+                any_lang = True
+            else:
+                any_untagged = True
+        if any_untagged:
+            p._untagged = None
+        if any_lang:
+            try:
+                del p._has_langs
+            except AttributeError:
+                pass
+
     def apply_schema(self, text: str) -> None:
         """Parse schema text into this store's schema state; journaled
         subclasses override (schema mutations, worker/mutation.go:94)."""

@@ -134,6 +134,10 @@ def apply_record(store: PostingStore, payload: bytes):
         pred, src, dst = codec.decode_bulk_edges(payload)
         PostingStore.bulk_set_uid_edges(store, pred, src, dst)
         return pred
+    elif tag == codec.BULKVALS:
+        pred, items = codec.decode_bulk_values(payload)
+        PostingStore.bulk_set_values(store, pred, items)
+        return pred
     elif tag == codec.DELPRED:
         pred, _ = codec.get_str(payload, 1)
         PostingStore.delete_predicate(store, pred)
@@ -296,6 +300,15 @@ class DurableStore(PostingStore):
         # one WAL record for the whole predicate group
         self._journal(codec.encode_bulk_edges(pred, src, dst))
         super().bulk_set_uid_edges(pred, src, dst)
+        self.applied_index += 1
+        if not self._replaying and not self._in_batch:
+            self.wal.flush()
+
+    def bulk_set_values(self, pred: str, items) -> None:
+        if not items:
+            return
+        self._journal(codec.encode_bulk_values(pred, items))
+        super().bulk_set_values(pred, items)
         self.applied_index += 1
         if not self._replaying and not self._in_batch:
             self.wal.flush()

@@ -111,6 +111,15 @@ def fast_apply_set(
     src_all = subj_uid[r.subj_idx]
     schema_tid: Dict[int, TypeID] = {}
     ordered_edges = []
+    # plain values divert to the bulk path (one dict pass per predicate)
+    # ONLY when no complex quad carries a literal: a faceted value write
+    # of the same (pred, src, lang) must keep its input-order position
+    # relative to plain writes (last-write-wins), and splitting the two
+    # streams would reorder them.
+    complex_has_value = bool(
+        np.any(is_complex & ((flags & F_OBJ_LITERAL) != 0))
+    )
+    bulk_vals: Dict[int, list] = {}
     for i in np.flatnonzero(is_value | is_complex).tolist():
         pi = int(r.pred_idx[i])
         facets = None
@@ -135,8 +144,11 @@ def fast_apply_set(
             if tid == TypeID.PASSWORD:
                 val = TypedValue(TypeID.PASSWORD, hash_password(str(val.value)))
         lang = langs[r.lang_idx[i]] if flags[i] & F_HAS_LANG else ""
-        ordered_edges.append(Edge(pred=preds[pi], src=int(src_all[i]),
-                                  value=val, lang=lang, facets=facets))
+        if facets is None and not complex_has_value:
+            bulk_vals.setdefault(pi, []).append((int(src_all[i]), lang, val))
+        else:
+            ordered_edges.append(Edge(pred=preds[pi], src=int(src_all[i]),
+                                      value=val, lang=lang, facets=facets))
 
     batch_cm = store.batch() if hasattr(store, "batch") else None
     if batch_cm is not None:
@@ -148,6 +160,11 @@ def fast_apply_set(
             for pi in np.unique(r.pred_idx[is_uid_edge]).tolist():
                 g = is_uid_edge & (r.pred_idx == pi)
                 store.bulk_set_uid_edges(preds[pi], src_all[g], dst_all[g])
+
+        # plain values: one dict pass + one WAL/proposal record per
+        # predicate group (input order preserved within each group)
+        for pi, items in bulk_vals.items():
+            store.bulk_set_values(preds[pi], items)
 
         # one batched apply: a single WAL flush standalone, one proposal
         # batch per group under replication

@@ -48,6 +48,35 @@ def test_edge_codec_roundtrip():
         assert (d.facets or {}) .keys() == (e.facets or {}).keys()
 
 
+def test_bulk_values_codec_and_durability(tmp_path):
+    """BULKVALS record round-trips (order preserved, last-write-wins)
+    and bulk-ingested values survive a restart."""
+    dt = datetime.datetime(1999, 12, 31)
+    items = [
+        (1, "", TypedValue(TypeID.STRING, "first")),
+        (2, "en", TypedValue(TypeID.STRING, "héllo")),
+        (3, "", TypedValue(TypeID.INT, -7)),
+        (4, "", TypedValue(TypeID.DATETIME, dt)),
+        (1, "", TypedValue(TypeID.STRING, "second")),  # same key: wins
+    ]
+    pred, got = codec.decode_bulk_values(codec.encode_bulk_values("p", items))
+    assert pred == "p" and len(got) == len(items)
+    for (s0, l0, v0), (s1, l1, v1) in zip(items, got):
+        assert (s0, l0, v0.tid, v0.value) == (s1, l1, v1.tid, v1.value)
+
+    s = _mk(tmp_path)
+    s.apply_schema("name: string @index(exact) .")
+    s.bulk_set_values("name", items)
+    assert s.value("name", 1).value == "second"  # input order applied
+    s.close()
+    r = _mk(tmp_path)
+    assert r.value("name", 1).value == "second"
+    assert r.value("name", 2, "en").value == "héllo"
+    assert r.value("name", 3).value == -7
+    assert r.value("name", 4).value == dt
+    r.close()
+
+
 def test_replay_restores_state(tmp_path):
     s = _mk(tmp_path)
     s.apply_schema("name: string @index(exact) .\nfriend: uid @reverse .")
