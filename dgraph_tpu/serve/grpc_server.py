@@ -445,6 +445,9 @@ class GrpcServer:
     def _run(self, req: bytes, context):
         import grpc
 
+        from dgraph_tpu.utils.metrics import NUM_GRPC_RUNS
+
+        NUM_GRPC_RUNS.add(1)
         try:
             text, vars_ = decode_request(req)
         except Exception as e:
@@ -499,6 +502,9 @@ class GrpcServer:
             context.abort(grpc.StatusCode.UNIMPLEMENTED, "not clustered")
         if not self._cluster_ok(context):
             context.abort(grpc.StatusCode.PERMISSION_DENIED, "bad cluster secret")
+        from dgraph_tpu.utils.metrics import NUM_GRPC_RAFT
+
+        NUM_GRPC_RAFT.add(1)
         try:
             group, frame = unframe_raft(decode_payload(req))
             cluster.deliver(group, frame)

@@ -134,5 +134,7 @@ PENDING_PROPOSALS = metrics.gauge("dgraph_pending_proposals")
 NUM_QUERIES = metrics.counter("dgraph_num_queries_total")
 NUM_MUTATIONS = metrics.counter("dgraph_num_mutations_total")
 ARENA_BYTES = metrics.gauge("dgraph_arena_bytes")
+NUM_GRPC_RUNS = metrics.counter("dgraph_grpc_runs_total")
+NUM_GRPC_RAFT = metrics.counter("dgraph_grpc_raft_frames_total")
 MAX_PL_LENGTH = metrics.gauge("dgraph_max_posting_list_length")
 PREDICATE_STATS = metrics.labeled("dgraph_predicate_mutations_total")
