@@ -24,14 +24,30 @@ from dgraph_tpu.client import (
 from dgraph_tpu.client.client import Transport
 
 
+def _make_transport(addr: str, use_grpc: bool) -> Transport:
+    """One server's transport: gRPC (the reference loader's native wire,
+    cmd/dgraphloader/main.go:222 grpc conns) or HTTP.  gRPC targets may
+    be given bare (host:port) or as http://host:port (mapped to the
+    +1000 convention)."""
+    if not use_grpc:
+        return HttpTransport(addr)
+    from dgraph_tpu.client import GrpcTransport
+
+    if addr.startswith(("http://", "https://")):
+        from dgraph_tpu.cluster.transport import grpc_target_of
+
+        addr = grpc_target_of(addr, 1000)
+    return GrpcTransport(addr)
+
+
 class RoundRobinTransport(Transport):
     """Spread requests over several servers (loader main.go:222)."""
 
-    def __init__(self, addrs):
+    def __init__(self, addrs, use_grpc: bool = False):
         import itertools
         import threading
 
-        self._ts = [HttpTransport(a) for a in addrs]
+        self._ts = [_make_transport(a, use_grpc) for a in addrs]
         self._next = itertools.cycle(self._ts)
         self._lock = threading.Lock()
 
@@ -127,10 +143,17 @@ def main(argv=None) -> int:
                    help="concurrent in-flight batch submitters")
     p.add_argument("--cd", dest="client_dir", default="",
                    help="client checkpoint dir (enables resume)")
+    p.add_argument("--grpc", action="store_true",
+                   help="connect over gRPC (protos.Dgraph/Run) instead of "
+                        "HTTP; http:// addresses map to port + 1000")
     ns = p.parse_args(argv)
 
     addrs = [a.strip() for a in ns.dgraph.split(",") if a.strip()]
-    transport = RoundRobinTransport(addrs) if len(addrs) > 1 else HttpTransport(addrs[0])
+    transport = (
+        RoundRobinTransport(addrs, use_grpc=ns.grpc)
+        if len(addrs) > 1
+        else _make_transport(addrs[0], ns.grpc)
+    )
     client = DgraphClient(
         transport, BatchMutationOptions(size=ns.batch, pending=ns.concurrent)
     )

@@ -452,6 +452,27 @@ def test_grpc_tls_listener_serves_secure_channel(tmp_path):
         srv.stop()
 
 
+def test_loader_over_grpc(servers, tmp_path):
+    """The bulk loader connects over gRPC (--grpc): schema + quads land
+    and checkpoint resume still works (re-run loads 0)."""
+    from dgraph_tpu.cli.loader import main as loader_main
+
+    srv, gsrv = servers
+    rdf = tmp_path / "fix.rdf"
+    rdf.write_text(
+        '<0x51> <name> "Loaded One" .\n<0x52> <name> "Loaded Two" .\n'
+        "<0x51> <follows> <0x52> .\n"
+    )
+    args = [
+        "--rdf", str(rdf), "-d", f"127.0.0.1:{gsrv.port}", "--grpc",
+        "--cd", str(tmp_path / "ckpt"),
+    ]
+    assert loader_main(args) == 0
+    out = srv.run_query('{ q(func: eq(name, "Loaded One")) { follows { name } } }')
+    assert out["q"] == [{"follows": [{"name": "Loaded Two"}]}]
+    assert loader_main(args) == 0  # resume: idempotent
+
+
 def test_channel_pool_refcount_and_probe(servers):
     _, gsrv = servers
     pool = ChannelPool()
