@@ -212,24 +212,19 @@ def decode_msg(b: bytes):
     raise ValueError(f"unknown raft message tag {tag:#x}")
 
 
-class HttpRaftTransport(Transport):
-    """Ships raft frames to peers over HTTP POST /raft/<group>.
+class _QueuedPeerTransport(Transport):
+    """Queue-per-peer / drop-don't-block sender discipline shared by the
+    raft transports: one bounded queue + daemon sender thread per peer —
+    the raft loop enqueues and returns; slow/dead peers drop frames
+    instead of applying backpressure to consensus (batchAndSendMessages
+    behavior, draft.go:434 'no need to send heartbeats if we can't send
+    messages').  Subclasses implement ``_sender``."""
 
-    One bounded queue + daemon sender thread per peer: the raft loop
-    enqueues and returns; slow/dead peers drop frames instead of
-    applying backpressure to consensus (batchAndSendMessages behavior,
-    draft.go:434 'no need to send heartbeats if we can't send messages').
-    """
+    _thread_prefix = "raft-send"
 
-    def __init__(
-        self,
-        addr_of: Dict[str, str],
-        timeout: float = 2.0,
-        auth: Optional[PeerAuth] = None,
-    ):
+    def __init__(self, addr_of: Dict[str, str], timeout: float):
         self.addr_of = dict(addr_of)      # node_id -> http(s)://host:port
         self.timeout = timeout
-        self.auth = auth
         self._queues: Dict[str, "queue.Queue"] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -242,7 +237,7 @@ class HttpRaftTransport(Transport):
                 self._queues[peer] = q
                 t = threading.Thread(
                     target=self._sender, args=(peer, q),
-                    name=f"raft-send-{peer}", daemon=True,
+                    name=f"{self._thread_prefix}-{peer}", daemon=True,
                 )
                 t.start()
             return q
@@ -261,6 +256,25 @@ class HttpRaftTransport(Transport):
             pass  # drop: raft retries via next heartbeat
 
     def _sender(self, peer: str, q: "queue.Queue") -> None:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class HttpRaftTransport(_QueuedPeerTransport):
+    """Ships raft frames to peers over HTTP POST /raft/<group>."""
+
+    def __init__(
+        self,
+        addr_of: Dict[str, str],
+        timeout: float = 2.0,
+        auth: Optional[PeerAuth] = None,
+    ):
+        super().__init__(addr_of, timeout)
+        self.auth = auth
+
+    def _sender(self, peer: str, q: "queue.Queue") -> None:
         while not self._stop.is_set():
             try:
                 group, body = q.get(timeout=0.5)
@@ -275,9 +289,6 @@ class HttpRaftTransport(Transport):
                 urlopen_peer(req, self.timeout, self.auth).read()
             except OSError:
                 pass  # peer down: drop, heartbeats will retry
-
-    def stop(self) -> None:
-        self._stop.set()
 
 
 def grpc_target_of(http_addr: str, port_offset: int) -> str:
@@ -296,12 +307,11 @@ def grpc_target_of(http_addr: str, port_offset: int) -> str:
     return f"{u.hostname}:{u.port + port_offset}"
 
 
-class GrpcRaftTransport(Transport):
+class GrpcRaftTransport(_QueuedPeerTransport):
     """Ships raft frames over the gRPC Worker plane
     (``/protos.Worker/RaftMessage``, serve/grpc_server.py) — the direct
-    analog of the reference's raft gRPC leg (worker/draft.go:1017).
-    Same queue-per-peer / drop-don't-block discipline as the HTTP
-    transport; the cluster secret rides gRPC metadata.
+    analog of the reference's raft gRPC leg (worker/draft.go:1017);
+    the cluster secret rides gRPC metadata.
 
     ``addr_of`` holds peer HTTP addresses (same contract as
     HttpRaftTransport, so runtime membership rewiring via update_peer is
@@ -309,6 +319,8 @@ class GrpcRaftTransport(Transport):
     re-announces on a new address is picked up by the live sender.
     https peers require ``auth.cafile`` — gRPC channels are TLS-verified
     with the pinned CA; there is no silent plaintext downgrade."""
+
+    _thread_prefix = "raft-grpc-send"
 
     def __init__(
         self,
@@ -318,17 +330,13 @@ class GrpcRaftTransport(Transport):
         port_offset: int = 1000,
         auth: Optional[PeerAuth] = None,
     ):
-        self.addr_of = dict(addr_of)
-        self.timeout = timeout
+        super().__init__(addr_of, timeout)
         self.secret = secret
         self.port_offset = port_offset
         self.auth = auth
         for a in self.addr_of.values():
             self._check_addr(a)
-        self._queues: Dict[str, "queue.Queue"] = {}
         self._chans: Dict[str, object] = {}  # target -> channel
-        self._lock = threading.Lock()
-        self._stop = threading.Event()
 
     def _check_addr(self, addr: str) -> None:
         grpc_target_of(addr, self.port_offset)  # raises if unmappable
@@ -343,7 +351,7 @@ class GrpcRaftTransport(Transport):
     def update_peer(self, nid: str, addr: str) -> None:
         self._check_addr(addr)
         old = self.addr_of.get(nid)
-        self.addr_of = {**self.addr_of, nid: addr}
+        super().update_peer(nid, addr)
         if old and old != addr:
             # close the superseded channel unless another peer still maps
             # to the same target — re-addressing members must not leak
@@ -377,27 +385,6 @@ class GrpcRaftTransport(Transport):
                     ch = grpc.insecure_channel(target)
                 self._chans[target] = ch
             return ch
-
-    def _queue_for(self, peer: str) -> "queue.Queue":
-        with self._lock:
-            q = self._queues.get(peer)
-            if q is None:
-                q = queue.Queue(maxsize=256)
-                self._queues[peer] = q
-                t = threading.Thread(
-                    target=self._sender, args=(peer, q),
-                    name=f"raft-grpc-send-{peer}", daemon=True,
-                )
-                t.start()
-            return q
-
-    def send(self, to: str, group: int, msg) -> None:
-        if to not in self.addr_of:
-            return
-        try:
-            self._queue_for(to).put_nowait((group, encode_msg(msg)))
-        except queue.Full:
-            pass  # drop: raft retries via next heartbeat
 
     def _sender(self, peer: str, q: "queue.Queue") -> None:
         from dgraph_tpu.serve.grpc_server import (
@@ -434,7 +421,7 @@ class GrpcRaftTransport(Transport):
                 pass  # peer down: drop, heartbeats will retry
 
     def stop(self) -> None:
-        self._stop.set()
+        super().stop()
         with self._lock:
             for ch in self._chans.values():
                 try:

@@ -283,8 +283,32 @@ _RU_V = "аеиоуыэюяё"
 
 def _ru_fold(sufs):
     """tok._normalize folds й→и (NFKD strips the combining breve), so
-    suffix lists must live in the FOLDED alphabet or they never match."""
+    suffix lists must live in the FOLDED alphabet or they never match.
+    Applied ONCE at module load — not per word."""
     return tuple(s.replace("й", "и") for s in sufs)
+
+
+_RU_ADJECTIVAL = _ru_fold((
+    "ейшими", "ейшего", "ейшему", "ейшая", "ейшее", "ейших", "ейший",
+    "ующими", "ившись", "ывшись", "авшись",
+    "ующая", "ующее", "ующий", "ующих",
+    "иями", "ями", "ами", "ыми", "ими", "его", "ого", "ему", "ому",
+    "ее", "ие", "ые", "ое", "ей", "ий", "ый", "ой", "ем", "им", "ым",
+    "ом", "их", "ых", "ую", "юю", "ая", "яя", "ою", "ею",
+))
+_RU_VERBAL = _ru_fold((
+    "уйте", "ейте", "ила", "ыла", "ена", "ите", "или", "ыли",
+    "ило", "ыло", "ено", "ует", "уют", "ить", "ыть", "ишь", "ете",
+    "йте", "ены", "нно", "ешь", "ть", "ет", "ют", "ны", "ло",
+    "но", "ла", "на", "ли", "ем", "ил", "ыл", "им", "ым", "ен",
+    "ят", "ит", "ыт", "уй", "ей", "ую", "й", "л", "н", "ю",
+))
+_RU_NOUN = _ru_fold((
+    "иями", "иях", "ией", "иям", "ием", "ями", "ами", "ях", "ам",
+    "ем", "ей", "ём", "ой", "ий", "ию", "ью", "ия", "ья", "ев",
+    "ов", "ие", "ье", "еи", "ии", "и", "ы", "ь", "ю", "я", "а",
+    "е", "о", "у", "й",
+))
 
 
 def _stem_ru(w: str) -> str:
@@ -295,34 +319,16 @@ def _stem_ru(w: str) -> str:
     r1 = _r1(w, _RU_V)
 
     def strip_class(word, sufs):
-        for suf in _ru_fold(sufs):
+        for suf in sufs:
             if word.endswith(suf) and len(word) - len(suf) >= max(r1, 2):
                 return word[: -len(suf)], True
         return word, False
 
-    w, hit = strip_class(w, (
-        "ейшими", "ейшего", "ейшему", "ейшая", "ейшее", "ейших", "ейший",
-        "ующими", "ившись", "ывшись", "авшись",
-        "ующая", "ующее", "ующий", "ующих",
-        "иями", "ями", "ами", "ыми", "ими", "его", "ого", "ему", "ому",
-        "ее", "ие", "ые", "ое", "ей", "ий", "ый", "ой", "ем", "им", "ым",
-        "ом", "их", "ых", "ую", "юю", "ая", "яя", "ою", "ею",
-    ))
+    w, hit = strip_class(w, _RU_ADJECTIVAL)
     if not hit:
-        w, hit = strip_class(w, (
-            "уйте", "ейте", "ила", "ыла", "ена", "ите", "или", "ыли",
-            "ило", "ыло", "ено", "ует", "уют", "ить", "ыть", "ишь", "ете",
-            "йте", "ены", "нно", "ешь", "ть", "ет", "ют", "ны", "ло",
-            "но", "ла", "на", "ли", "ем", "ил", "ыл", "им", "ым", "ен",
-            "ят", "ит", "ыт", "уй", "ей", "ую", "й", "л", "н", "ю",
-        ))
+        w, hit = strip_class(w, _RU_VERBAL)
     if not hit:
-        w, _ = strip_class(w, (
-            "иями", "иях", "ией", "иям", "ием", "ями", "ами", "ях", "ам",
-            "ем", "ей", "ём", "ой", "ий", "ию", "ью", "ия", "ья", "ев",
-            "ов", "ие", "ье", "еи", "ии", "и", "ы", "ь", "ю", "я", "а",
-            "е", "о", "у", "й",
-        ))
+        w, _ = strip_class(w, _RU_NOUN)
     for suf in ("ость", "ост"):
         if w.endswith(suf) and len(w) - len(suf) >= max(r1, 2):
             w = w[: -len(suf)]
