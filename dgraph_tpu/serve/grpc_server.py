@@ -37,9 +37,6 @@ from dgraph_tpu.serve import proto as _p
 
 _TAG = "0.7.0-tpu"  # CheckVersion tag (x/version analog)
 
-# Facet.ValType enum (facets.proto:26): STRING, INT, FLOAT, BOOL, DATETIME
-_FACET_TYPES = {0: "string", 1: "int", 2: "float", 3: "bool", 4: "datetime"}
-
 
 def _zigzag(n: int) -> int:
     """sint32/sint64 wire decode (objectType is sint32)."""

@@ -129,6 +129,49 @@ def test_run_typed_value_and_schema_update(chan, servers):
     assert out["q"] == [{"name": "Grace", "age": 36}]
 
 
+def test_run_value_oneof_forms(chan, servers):
+    """NQuad object_value oneof coverage: uid_val makes an EDGE,
+    double_val/bool_val convert under the schema, lang tags apply."""
+    import struct
+
+    srv, _ = servers
+    srv.run_query(
+        "mutation { schema { ratio: float . flag: bool . } }"
+    )
+    from dgraph_tpu.serve.proto import _key
+
+    dv = _key(6, 1) + struct.pack("<d", 2.75)  # Value{double_val=2.75}
+    nq_ratio = (
+        _str_field(1, "0x61") + _str_field(2, "ratio") + _len_field(4, dv)
+    )
+    nq_flag = (
+        _str_field(1, "0x61")
+        + _str_field(2, "flag")
+        + _len_field(4, _varint_field(4, 1))  # Value{bool_val=true}
+    )
+    # Value{uid_val=0x62}: an edge, not a literal
+    nq_uid = (
+        _str_field(1, "0x61")
+        + _str_field(2, "follows")
+        + _len_field(4, _varint_field(11, 0x62))
+    )
+    nq_lang = (
+        _str_field(1, "0x61")
+        + _str_field(2, "name")
+        + _len_field(4, _str_field(5, "Szia"))
+        + _str_field(7, "hu")  # lang=7
+    )
+    m = b"".join(_len_field(1, nq) for nq in (nq_ratio, nq_flag, nq_uid, nq_lang))
+    _run(chan, _len_field(2, m))
+    out = srv.run_query(
+        '{ q(func: uid(0x61)) { ratio flag name@hu follows { _uid_ } } }'
+    )
+    assert out["q"] == [
+        {"ratio": 2.75, "flag": True, "name@hu": "Szia",
+         "follows": [{"_uid_": "0x62"}]}
+    ]
+
+
 def test_run_del_nquad(chan, servers):
     srv, _ = servers
     srv.run_query('mutation { set { <0x9> <name> "Tmp" . } }')
