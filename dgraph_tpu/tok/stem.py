@@ -391,6 +391,110 @@ _stem_no = _scand_stemmer((
 ))
 
 
+def _stem_hu(w: str) -> str:
+    """Light Hungarian: case suffixes, then the bare plural -k after a
+    vowel, then the residual final a/e — cascaded, because Hungarian
+    stacks case on plural (házakat → hazak → haza → haz).  Accented
+    vowels are already folded to aeiou upstream."""
+    V = "aeiou"
+    r1 = _r1(w, V, 2)
+    for suf in (
+        "oknak", "eknek", "aknak", "okban", "ekben", "akban", "okat",
+        "eket", "akat", "okba", "ekbe", "akba", "nak", "nek", "ban",
+        "ben", "bol", "rol", "tol", "val", "vel", "hoz", "hez", "koz",
+        "ra", "re", "ba", "be", "on", "en", "an", "ot", "et", "at",
+    ):
+        if w.endswith(suf) and len(w) - len(suf) >= max(r1, 2):
+            w = w[: -len(suf)]
+            break
+    if (
+        w.endswith("k")
+        and len(w) >= 2
+        and w[-2] in V
+        and len(w) - 1 >= max(r1, 2)
+    ):
+        w = w[:-1]
+    if w and w[-1] in "ae" and len(w) - 1 >= max(r1, 2):
+        w = w[:-1]
+    return w
+
+
+def _stem_ro(w: str) -> str:
+    """Light Romanian: definite articles + plural/verb endings in R1,
+    then the residual final a/e/i (diacritics ă/â/î/ș/ț fold upstream)."""
+    V = "aeiou"
+    r1 = _r1(w, V, 2)
+    for suf in (
+        "urilor", "atiilor", "iilor", "elor", "ilor", "ului", "atii",
+        "atie", "urile", "uri", "ule", "ele", "eau", "ind", "and",
+        "are", "ere", "ire", "ate", "ute", "ite", "ii", "ul", "le",
+        "ea", "ia", "ie", "iu",
+    ):
+        if w.endswith(suf) and len(w) - len(suf) >= max(r1, 2):
+            w = w[: -len(suf)]
+            break
+    if w and w[-1] in "aei" and len(w) - 1 >= max(r1, 2):
+        w = w[:-1]
+    return w
+
+
+def _stem_fi(w: str) -> str:
+    """Light Finnish: the productive locative/partitive/genitive case
+    endings and plural -t/-ja, cascaded once (ä/ö fold to a/o
+    upstream, so talossa/taloissa both reduce over 'a-o' vowels)."""
+    V = "aeiouy"
+    r1 = _r1(w, V, 2)
+    for suf in (
+        "issa", "ista", "illa", "ilta", "ille", "iksi", "ssa", "sta",
+        "lla", "lta", "lle", "ksi", "tta", "nsa", "ja", "an", "en",
+        "in", "na", "ta",
+    ):
+        if w.endswith(suf) and len(w) - len(suf) >= max(r1, 2):
+            if suf == "ja" and w[-3] not in V:
+                continue  # partitive -ja follows a vowel (autoja, not kirja)
+            w = w[: -len(suf)]
+            break
+    if (
+        w.endswith("t")
+        and len(w) >= 2
+        and w[-2] in V
+        and len(w) - 1 >= max(r1, 2)
+    ):
+        w = w[:-1]
+    if w and w[-1] == "i" and len(w) - 1 >= max(r1, 2):
+        w = w[:-1]
+    return w
+
+
+def _stem_tr(w: str) -> str:
+    """Light Turkish: the agglutinated plural/possessive/case chain via
+    ordered suffix strips (longest first), twice — Turkish stacks e.g.
+    ev+ler+in+de.  Dotless ı survives NFKD and counts as a vowel; ş/ç/ğ
+    fold to s/c/g upstream."""
+    V = "aeiouı"  # ı
+    r1 = _r1(w, V, 2)
+    for _ in range(2):
+        for suf in (
+            "larinin", "lerinin", "larinda", "lerinde", "larindan",
+            "lerinden", "larin", "lerin", "lari", "leri", "larda",
+            "lerde", "lardan", "lerden", "lar", "ler", "nin",
+            "nun", "dan", "den", "tan", "ten", "da", "de", "ta", "te",
+            "in", "un", "si", "su",
+        ):
+            if w.endswith(suf) and len(w) - len(suf) >= max(r1, 2):
+                w = w[: -len(suf)]
+                break
+        else:
+            break
+    # harmony variants with dotless ı (ları / ının / ında …)
+    for suf in ("ları", "ının", "ında", "ından",
+                "ın", "ı"):
+        if w.endswith(suf) and len(w) - len(suf) >= max(r1, 2):
+            w = w[: -len(suf)]
+            break
+    return w
+
+
 _STEMMERS = {
     "de": _stem_de,
     "fr": _stem_fr,
@@ -403,12 +507,17 @@ _STEMMERS = {
     "da": _stem_da,
     "no": _stem_no,
     "nb": _stem_no,  # Bokmål tag maps to the Norwegian stemmer
+    "hu": _stem_hu,
+    "ro": _stem_ro,
+    "fi": _stem_fi,
+    "tr": _stem_tr,
 }
 
 # languages with a real stemmer + stopword list (PARITY: the reference
 # ships every snowball language via bleve; we document this set)
 SUPPORTED_LANGS = (
     "en", "de", "fr", "es", "it", "pt", "nl", "ru", "sv", "da", "no",
+    "hu", "ro", "fi", "tr",
 )
 
 

@@ -50,4 +50,20 @@ STOPWORDS = {
         """og i jeg det at en et den til er som på de med han av ikke
         der så var meg seg men ett har om vi min mitt ha hadde hun nå""".split()
     ),
+    "hu": frozenset(
+        """a az és hogy nem is egy de meg ez el volt ha mint csak már
+        még vagy ki mi fel be ő őt aki ami ezek azok""".split()
+    ),
+    "ro": frozenset(
+        """și în a la cu de pe un o este sunt era nu se ce care mai dar
+        pentru din sau fi el ea ei ele acest această""".split()
+    ),
+    "fi": frozenset(
+        """ja on ei se että en hän oli mutta niin kun myös joka mikä
+        tai jos sitä ole nyt vain kuin mitä siis me he""".split()
+    ),
+    "tr": frozenset(
+        """ve bir bu da de için ile mi ne o ki gibi daha çok en az ama
+        ya hem şu ben sen biz siz onlar değil var yok""".split()
+    ),
 }

@@ -71,6 +71,15 @@ def term_tokens(s: str) -> List[str]:
     return sorted(set(_WORD_RE.findall(_normalize(s))))
 
 
+# Stopwords are matched against NORMALIZED tokens, so the lists must
+# live in the folded alphabet too ("és"→"es", "für"→"fur") — folded once
+# at import, or accented entries silently never match.
+_STOP_FOLDED = {
+    code: frozenset(_normalize(x) for x in words)
+    for code, words in STOPWORDS.items()
+}
+
+
 def fulltext_tokens(s: str, lang: str = "en") -> List[str]:
     """fulltext: term pipeline + stopword removal + stemming
     (tok/fts.go:46-142).  The language tag normalizes HERE — region
@@ -78,9 +87,10 @@ def fulltext_tokens(s: str, lang: str = "en") -> List[str]:
     every query surface reduce under identical rules no matter which
     tag spelling reaches them."""
     code = (lang or "en").split(",")[0].split("-")[0].lower() or "en"
+    stop = _STOP_FOLDED.get(code, _STOP_FOLDED["en"])
     out = set()
     for w in _WORD_RE.findall(_normalize(s)):
-        if w in STOPWORDS.get(code, STOPWORDS["en"]):
+        if w in stop:
             continue
         out.add(stem(w, code))
     return sorted(out)

@@ -308,6 +308,36 @@ def test_fulltext_ru_sv_da_no_inflections():
     )
 
 
+def test_fulltext_hu_ro_fi_tr_inflections():
+    """Hungarian/Romanian/Finnish/Turkish light analyzers: case chains,
+    definite articles, locative cases and agglutinated suffix stacks all
+    conflate with the base form."""
+    from dgraph_tpu import tok
+
+    # Hungarian: plural, inessive, stacked plural+accusative
+    assert tok.fulltext_tokens("házak", "hu") == tok.fulltext_tokens("ház", "hu")
+    assert tok.fulltext_tokens("házban", "hu") == tok.fulltext_tokens("ház", "hu")
+    assert tok.fulltext_tokens("házakat", "hu") == tok.fulltext_tokens("ház", "hu")
+    assert tok.fulltext_tokens("kertekben", "hu") == tok.fulltext_tokens("kert", "hu")
+    # Romanian: definite plural article, plural, genitive article
+    assert tok.fulltext_tokens("casele", "ro") == tok.fulltext_tokens("casa", "ro")
+    assert tok.fulltext_tokens("cărți", "ro") == tok.fulltext_tokens("carte", "ro")
+    assert tok.fulltext_tokens("orașului", "ro") == tok.fulltext_tokens("oraș", "ro")
+    # Finnish: inessive (sg+pl), partitive plural, nominative plural
+    assert tok.fulltext_tokens("talossa", "fi") == tok.fulltext_tokens("talo", "fi")
+    assert tok.fulltext_tokens("taloissa", "fi") == tok.fulltext_tokens("talo", "fi")
+    assert tok.fulltext_tokens("autoja", "fi") == tok.fulltext_tokens("auto", "fi")
+    assert tok.fulltext_tokens("kirjat", "fi") == tok.fulltext_tokens("kirja", "fi")
+    # Turkish: plural, plural+genitive+locative stack, harmony variants
+    assert tok.fulltext_tokens("evler", "tr") == tok.fulltext_tokens("ev", "tr")
+    assert tok.fulltext_tokens("evlerinde", "tr") == tok.fulltext_tokens("ev", "tr")
+    assert tok.fulltext_tokens("kitaplar", "tr") == tok.fulltext_tokens("kitap", "tr")
+    assert tok.fulltext_tokens("kitapları", "tr") == tok.fulltext_tokens("kitap", "tr")
+    # stopwords are per-language ("és" Hungarian, "ve" Turkish)
+    assert tok.fulltext_tokens("és ház", "hu") == tok.fulltext_tokens("ház", "hu")
+    assert tok.fulltext_tokens("ve ev", "tr") == tok.fulltext_tokens("ev", "tr")
+
+
 def test_alloftext_lang_matches_inflections():
     """alloftext(name@de, ...) matches German inflections end-to-end: the
     index analyzes each value under ITS lang tag, the query under the
