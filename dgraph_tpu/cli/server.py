@@ -85,6 +85,10 @@ def build_options(argv=None) -> Options:
     p.add_argument("--mem", dest="mem_profile", default=d.mem_profile,
                    help="write a memory allocation profile (tracemalloc "
                         "top-50 text) here on shutdown")
+    p.add_argument("--compile_cache", default=d.compile_cache,
+                   help="persistent XLA compilation cache dir; 'auto' = "
+                        "<postings>/.jitcache, '' disables (repeat cold "
+                        "starts skip the seconds-long first compile)")
     ns = p.parse_args(argv)
     # start from the YAML-merged defaults so Options fields without a flag
     # survive (previously YAML-only keys like workers were dropped)
@@ -130,6 +134,24 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
+    if opts.compile_cache:
+        # persistent XLA compilation cache: a restarted server re-uses
+        # every compiled query shape instead of paying the seconds-long
+        # Mosaic/XLA compile again (the reference has no compile step at
+        # all, so repeat cold-start parity depends on this)
+        import jax
+
+        cache_dir = (
+            os.path.join(opts.postings_dir, ".jitcache")
+            if opts.compile_cache == "auto"
+            else opts.compile_cache
+        )
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        except (OSError, AttributeError) as e:
+            print(f"warning: compile cache disabled: {e}", file=sys.stderr)
     # profiling surface (setupProfiling, cmd/dgraph/main.go:181).  The
     # CPU profile covers QUERY EXECUTION (enabled per-request under the
     # engine lock — cProfile is per-thread, and a main-thread profiler

@@ -66,6 +66,11 @@ class Options:
     # (posting/lists.go:191 --memory_mb, posting/lru.go:57).
     memory_mb: int = 0
 
+    # persistent XLA compilation cache: first-compile of a query shape
+    # costs seconds on TPU; caching across restarts makes repeat cold
+    # starts warm.  "auto" = <postings_dir>/.jitcache, "" disables.
+    compile_cache: str = "auto"
+
     # directory for per-query execution-shape dumps (--dumpsg,
     # cmd/dgraph/main.go:347); empty = disabled
     dumpsg: str = ""
