@@ -62,6 +62,22 @@ def main():
 
     platform = ensure_backend()
     print(f"# backend: {platform}", flush=True)
+    # persistent compile cache (same lever as the server's
+    # --compile_cache): repeat runs' cold_ms measures process-restart
+    # cold — the reference's anchor semantics — not XLA compile time.
+    # B21_COMPILE_CACHE="" disables.
+    cache_dir = os.environ.get("B21_COMPILE_CACHE", "scratch/.jitcache")
+    if cache_dir:
+        import jax as _jax
+
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            _jax.config.update("jax_compilation_cache_dir", cache_dir)
+            _jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5
+            )
+        except (OSError, AttributeError):
+            pass
     global SCHEMA, build, PostingStore, QueryEngine
     from bench_engine import SCHEMA, build
     from dgraph_tpu.models import PostingStore
