@@ -408,6 +408,67 @@ def test_mutation_brace_matching_adversarial():
         parse('mutation { set { <a> <p> "unclosed } ')
 
 
+def test_match_brace_fuzz_vs_reference():
+    """The line-seeking brace matcher == the straightforward per-char
+    state machine on randomized bodies mixing quoted braces, IRIs,
+    comments and nested sections (the bulk-load rewrite's safety net)."""
+    import numpy as np
+
+    from dgraph_tpu.gql.parser import ParseError, _match_brace
+
+    def slow_match(text, open_idx):
+        # the pre-round-5 algorithm, kept verbatim as the oracle
+        depth = 0
+        i, n = open_idx, len(text)
+        while i < n:
+            c = text[i]
+            if c == '"':
+                i += 1
+                while i < n and text[i] != '"':
+                    i += 2 if text[i] == "\\" else 1
+            elif c == "#":
+                while i < n and text[i] != "\n":
+                    i += 1
+            elif c == "<":
+                j = text.find(">", i + 1)
+                if j != -1 and "\n" not in text[i:j]:
+                    i = j
+            elif c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+                if depth == 0:
+                    return i
+            i += 1
+        raise ParseError("unbalanced braces")
+
+    rng = np.random.default_rng(21)
+    pieces = [
+        '<a> <p> <b> .\n',
+        '<a> <p> "plain lit" .\n',
+        '<a> <p> "curly } brace {" .\n',
+        '<a> <p> "esc \\" quote }" .\n',
+        '<a> <q> <http://x/{y}> .\n',
+        "# comment } with { braces\n",
+        '<a> <p> "tail" . # trailing } comment\n',
+        "inner { <c> <d> <e> . }\n",
+        "{ }\n",
+    ]
+    for trial in range(200):
+        k = int(rng.integers(1, 12))
+        body = "".join(pieces[int(j)] for j in rng.integers(0, len(pieces), k))
+        text = "{" + body + "}"
+        try:
+            want = slow_match(text, 0)
+        except ParseError:
+            want = None
+        try:
+            got = _match_brace(text, 0)
+        except ParseError:
+            got = None
+        assert got == want, f"trial {trial}: {text!r}"
+
+
 def test_mutation_and_query_together():
     res = parse("""
     mutation { set { <a> <p> <b> . } }
