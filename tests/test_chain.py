@@ -103,6 +103,21 @@ def test_chain_actually_fuses():
     assert any(ok for _a, ok in calls), calls
 
 
+def test_chain_reject_reasons_recorded():
+    """Non-engagement must self-describe (VERDICT r4 weak #2): a chain
+    below the fan-out threshold records WHY in stats['chain_reject'];
+    a fused query records nothing."""
+    eng = build_engine(3, threshold=1 << 60)  # threshold nothing can meet
+    eng.run("{ q(func: uid(0x1)) { knows { knows { name } } } }")
+    rejects = eng.stats["chain_reject"]
+    assert any("below threshold" in r for r in rejects), rejects
+
+    eng2 = build_engine(3, threshold=0)  # fuse everything fusable
+    eng2.run("{ q(func: uid(0x1)) { knows { knows { name } } } }")
+    assert eng2.stats["chain_fused_levels"] > 0
+    assert eng2.stats["chain_reject"] == []
+
+
 def test_chain_deep_and_empty_levels():
     """Chains that dead-end mid-way (empty tail predicate) stay correct."""
     def mk(threshold):
