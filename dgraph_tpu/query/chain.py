@@ -50,6 +50,14 @@ from dgraph_tpu.ops.sets import SENT
 # Matches DGRAPH_TPU_EXPAND_DEVICE_MIN by design: once individual levels
 # would dispatch to the device anyway, one fused dispatch strictly beats
 # one per level; below it, host numpy wins on transport latency.
+# Fan-out (estimated total edges) below which fusing is not attempted.
+# Provenance: at the measured ~0.14 ms per-query fixed overhead and the
+# r4 profile's per-edge device win, the break-even sits well under this;
+# 256k keeps a safety margin for the host-side cap planning + packed-
+# buffer conversion the fused path adds (both scale with capacity, not
+# fan-out).  Tunable per deployment; bench21m records `chain_reject`
+# with the estimate whenever the threshold declines a chain, so the
+# setting is auditable against real workloads.
 CHAIN_THRESHOLD = int(os.environ.get("DGRAPH_TPU_CHAIN_THRESHOLD", 262144))
 # abandon plans whose per-level output would exceed this many chunks.
 # Full-mode chains transfer their matrices, so the cap is transfer-sized;
