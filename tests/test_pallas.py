@@ -134,6 +134,39 @@ def test_expand_inline_grouped_pallas_under_vmap():
         assert np.array_equal(np.asarray(w), np.asarray(g))
 
 
+@pytest.mark.parametrize("total_target", [127, 128, 129, 255, 256, 257, 383])
+def test_slotmap_pallas_block_boundaries(total_target):
+    """Totals straddling the 128-slot block boundary: the per-block
+    prefix/window logic must hand off exactly at multiples of 128."""
+    from dgraph_tpu.ops.pallas_slotmap import slotmap_pallas, slotmap_reference
+
+    rng = np.random.default_rng(total_target)
+    pcap, capc = 256, 512
+    cs = []
+    cd = []
+    nxt = 0
+    total = 0
+    while total < total_target:
+        d = int(rng.integers(1, 5))
+        d = min(d, total_target - total)
+        gap = int(rng.integers(0, 2))
+        nxt += gap
+        cs.append(nxt)
+        cd.append(d)
+        nxt += d
+        total += d
+    csp = np.zeros(pcap, np.int32)
+    cdp = np.zeros(pcap, np.int32)
+    csp[: len(cs)] = cs
+    cdp[: len(cd)] = cd
+    got = np.asarray(
+        slotmap_pallas(jnp.asarray(csp[None]), jnp.asarray(cdp[None]), capc,
+                       interpret=True)
+    )[0]
+    want = slotmap_reference(csp[: len(cs)], cdp[: len(cd)], capc)
+    assert np.array_equal(got, want)
+
+
 def test_slotmap_pallas_dense_and_edge_cases():
     from dgraph_tpu.ops.pallas_slotmap import slotmap_pallas, slotmap_reference
 
