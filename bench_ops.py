@@ -1,0 +1,97 @@
+"""Per-kernel microbenchmarks: the measured numbers behind
+docs/ROOFLINE.md, reproducible in one command.
+
+Measures, at headline-bench-like shapes (200-query batches):
+  - expand_inline_grouped      (XLA slot-map)
+  - expand_inline_grouped_pallas (Pallas slot-map; interpret off-TPU)
+  - sort_unique dedup at the hop-2 width
+  - member_mask set membership
+One JSON line per kernel: {"kernel", "value", "unit", "platform"}.
+
+Usage: python bench_ops.py    (env: BO_NODES/BO_EDGES/BO_Q scale it;
+same wedged-TPU probe contract as bench.py)
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    from bench import ensure_backend
+
+    platform = ensure_backend()
+    import jax
+    import jax.numpy as jnp
+
+    from dgraph_tpu import ops
+    from dgraph_tpu.ops.sets import SENT
+    from bench import build_graph
+
+    n_nodes = int(os.environ.get("BO_NODES", 500_000))
+    n_edges = int(os.environ.get("BO_EDGES", 4_000_000))
+    Q = int(os.environ.get("BO_Q", 200))
+    n_seeds = 2048
+
+    a = build_graph(n_nodes, n_edges)
+    metap, ov = a.inline_layout_grouped()
+    deg = (a.h_offsets[1:] - a.h_offsets[:-1]).astype(np.int64)
+    rng = np.random.default_rng(7)
+    fronts = []
+    for _ in range(Q):
+        f = np.unique(rng.integers(1, n_nodes + 1, size=n_seeds))
+        key = np.asarray(ops.skey_encode(f, deg[f] > ops.INLINE))
+        fronts.append(f[np.argsort(key, kind="stable")])
+    fcap = ops.bucket(max(len(f) for f in fronts))
+    capc = ops.bucket_fine(
+        max(int(a.ov_chunk_degree_of_rows(f).sum()) for f in fronts)
+    )
+    pcap = ops.bucket_fine(
+        max(int((deg[f] > ops.INLINE).sum()) for f in fronts)
+    )
+    fmat = jnp.asarray(np.stack([ops.pad_to(f, fcap) for f in fronts]))
+    rows = jnp.where(fmat == SENT, -1, fmat)
+    edges_total = sum(int(deg[f].sum()) for f in fronts)
+
+    def best(fn, n=4):
+        fn()  # compile
+        b = float("inf")
+        for _ in range(n):
+            t0 = time.time()
+            jax.block_until_ready(fn())
+            b = min(b, time.time() - t0)
+        return b
+
+    def emit(kernel, value, unit):
+        print(json.dumps({
+            "kernel": kernel, "value": round(value, 1), "unit": unit,
+            "platform": platform,
+        }), flush=True)
+
+    for name, expander in (
+        ("expand_inline_grouped", ops.expand_inline_grouped),
+        ("expand_inline_grouped_pallas", ops.expand_inline_grouped_pallas),
+    ):
+        run = jax.jit(jax.vmap(lambda r: expander(metap, ov, r, capc, pcap)))
+        s = best(lambda: run(rows))
+        emit(name, edges_total / s, "edges/s")
+
+    wide = ops.bucket(fcap * ops.INLINE + capc * ops.CHUNK // 4)
+    mat = jnp.asarray(
+        rng.integers(1, n_nodes, size=(Q, wide)).astype(np.int32)
+    )
+    s = best(lambda: jax.jit(jax.vmap(ops.sort_unique))(mat))
+    emit("sort_unique", Q * wide / s, "elems/s")
+
+    b = jnp.asarray(
+        np.sort(rng.integers(1, n_nodes, size=(Q, 4096)).astype(np.int32), axis=1)
+    )
+    mm = jax.jit(jax.vmap(ops.member_mask))
+    s = best(lambda: mm(mat[:, :4096], b))
+    emit("member_mask", Q * 4096 / s, "probes/s")
+
+
+if __name__ == "__main__":
+    main()
