@@ -713,8 +713,15 @@ class QueryEngine:
                 dtype=np.int64,
                 count=len(ef),
             )
-            fpos = order[np.clip(np.searchsorted(skeys, fkeys), 0, E - 1)]
-            fdicts = list(ef.values())
+            pos = np.clip(np.searchsorted(skeys, fkeys), 0, max(0, E - 1))
+            # guard: a facet key whose edge is no longer in the list (an
+            # earlier mask pruned it after loading) must be DROPPED, not
+            # land on an arbitrary clipped position
+            hit = skeys[pos] == fkeys if E else np.zeros(len(fkeys), bool)
+            fpos = order[pos[hit]]
+            fdicts = [
+                f for f, h in zip(ef.values(), hit.tolist()) if h
+            ]
         else:
             fpos = np.zeros(0, np.int64)
             fdicts = []
