@@ -183,6 +183,22 @@ def test_run_del_nquad(chan, servers):
     assert out["q"] == []
 
 
+def test_run_mutation_and_query_in_one_request(chan):
+    """Request carrying BOTH a mutation and a query executes the
+    mutation first, then the query against the mutated state (the
+    ProcessWithMutation ordering, query/query.go:2371)."""
+    nq = (
+        _str_field(1, "0x71")
+        + _str_field(2, "name")
+        + _len_field(4, _str_field(5, "Combined"))
+    )
+    req = _str_field(
+        1, '{ q(func: eq(name, "Combined")) { _uid_ } }'
+    ) + _len_field(2, _len_field(1, nq))
+    out = _run(chan, req)
+    assert out["q"] == [{"_uid_": "0x71"}]
+
+
 def test_schema_request(chan):
     # Request{schema=3 SchemaRequest{predicates=["name"]}}
     req = _len_field(3, _str_field(2, "name"))
