@@ -2,29 +2,43 @@
 
 Mirrors BASELINE.json's north-star metric: a Freebase-21M-scale synthetic
 graph (2M nodes, ~21M edges, skewed degrees), 2-hop traversal from random
-seed sets.  The device path — inline-head expansion (ops.expand_inline:
-each 32-byte row gather returns metadata AND the first INLINE targets,
-with overflow chunks + scatter/prefix-sum slot mapping for long rows),
-stability-free sort dedup, one vmapped program for the whole query
-batch — is measured against a fully-vectorized NumPy implementation of
+seed sets, measured against a fully-vectorized NumPy implementation of
 the same semantics (the stand-in for the reference's CPU posting-list
 walk).
+
+The device side runs the FUSED BATCHED HOP EXECUTOR (dgraph_tpu/ops/
+batch.py): one device program per hop for the whole query batch, in one
+of two dedup strategies:
+
+- ``host`` (default off-TPU): each hop is a degree-classed gather
+  program — scatter- and sort-free, because XLA-on-CPU's scatter
+  (~100ns/update) and sort (~10× numpy) would otherwise dominate — and
+  the inter-hop frontier dedup runs as numpy np.unique overlapped with
+  the device's async dispatch queue.  2 programs per query batch, not
+  one per set-op.
+- ``device`` (default on TPU): the whole 2-hop pipeline for a batch of
+  queries is ONE jitted program (inline-head expansion + skey-grouped
+  sort dedup, the round-5 TPU path); the frontier never leaves HBM.
+
 Every query's output materializes on device (per-query checksums, all
 verified against numpy), so the edges/s number cannot be faked by XLA
 dead-code elimination.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline",
+"fused_hop", "hop_dedup", ...}.
 Environment knobs: BENCH_NODES, BENCH_EDGES, BENCH_SEEDS, BENCH_ITERS,
 BENCH_SCALE (shrink everything by a factor: 0.1 -> 200k nodes / 2.1M
-edges), BENCH_PROBE_TIMEOUT / BENCH_INIT_RETRIES (backend probe knobs).
+edges), BENCH_DEDUP (host|device|auto), BENCH_PROBE_BUDGET /
+BENCH_PROBE_TIMEOUT / BENCH_INIT_RETRIES (backend probe knobs).
 
 Robustness contract (round-1 postmortem: the round artifact was empty
 because a wedged TPU turned into an unhandled stack dump): the TPU
 backend is probed in a SUBPROCESS with a hard timeout — a wedged chip
-hangs inside C++ where no Python-level timeout can fire — with retries
-and backoff; if it never comes up we say so in one stderr line and fall
-back to XLA-on-CPU so the round still records a real (if unflattering)
-number.  A mid-run failure retries once at BENCH_SCALE/8.
+hangs inside C++ where no Python-level timeout can fire.  The TOTAL
+probe budget is capped (BENCH_PROBE_BUDGET, default 90s — round 5
+burned 5×(120s+backoff) ≈ 13 minutes on a wedged chip before falling
+back); the outcome is ONE structured ``backend_probe`` json line on
+stderr, win or lose.  A mid-run failure retries once at BENCH_SCALE/8.
 """
 
 import json
@@ -42,9 +56,51 @@ _PROBE = (
 )
 
 
+def _probe_once(timeout_s: float):
+    """One out-of-process backend probe.  Returns (platform or None,
+    error string).  Own process GROUP + file-backed output: the TPU
+    plugin spawns tunnel helpers that inherit pipes — after a timeout
+    kill of the probe alone, communicate() would block on the helper's
+    copy of stdout forever (observed with a wedged chip)."""
+    import tempfile
+
+    with tempfile.TemporaryFile("w+") as out, tempfile.TemporaryFile("w+") as err:
+        p = subprocess.Popen(
+            [sys.executable, "-c", _PROBE],
+            stdout=out,
+            stderr=err,
+            text=True,
+            start_new_session=True,
+        )
+        try:
+            rc = p.wait(timeout=timeout_s)
+            out.seek(0)
+            err.seek(0)
+            if rc == 0:
+                lines = out.read().strip().splitlines()
+                if lines:
+                    return lines[-1], ""
+                return None, "probe printed nothing"
+            return None, (err.read().strip().splitlines() or ["rc=%d" % rc])[-1]
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                p.kill()  # group signal denied: at least the child dies
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass  # unreaped zombie beats an unbounded hang
+            return None, f"probe hung >{timeout_s:.0f}s (backend wedged?)"
+
+
 def ensure_backend() -> str:
-    """Probe the default (TPU) backend out-of-process with a timeout;
-    fall back to CPU after retries.  Returns the platform chosen."""
+    """Probe the default (TPU) backend out-of-process under a hard TOTAL
+    time budget; fall back to CPU when the budget is spent.  Emits ONE
+    structured ``backend_probe`` json line on stderr either way and
+    returns the platform chosen."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         # env var alone is not enough: this image's sitecustomize imports
         # jax at interpreter startup, consuming JAX_PLATFORMS before user
@@ -53,63 +109,39 @@ def ensure_backend() -> str:
 
         jax.config.update("jax_platforms", "cpu")
         return "cpu"
-    # round-end runs are one-shot: wait out a recovering tunnel (5 probes
-    # with exponential backoff ≈ 13 minutes max) before settling for CPU
-    retries = int(os.environ.get("BENCH_INIT_RETRIES", 5))
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+    budget = float(os.environ.get("BENCH_PROBE_BUDGET", 90))
+    per_probe = float(os.environ.get("BENCH_PROBE_TIMEOUT", 45))
+    max_tries = int(os.environ.get("BENCH_INIT_RETRIES", 3))
+    t0 = time.time()
+    attempts = 0
     last = ""
-    for attempt in range(retries):
-        # own process GROUP + file-backed output: the TPU plugin spawns
-        # tunnel helpers that inherit pipes — after a timeout kill of the
-        # probe alone, communicate() would block on the helper's copy of
-        # stdout forever (observed with a wedged chip).  killpg reaps the
-        # whole group and files can't block.
-        import tempfile
-
-        with tempfile.TemporaryFile("w+") as out, tempfile.TemporaryFile("w+") as err:
-            p = subprocess.Popen(
-                [sys.executable, "-c", _PROBE],
-                stdout=out,
-                stderr=err,
-                text=True,
-                start_new_session=True,
-            )
-            try:
-                rc = p.wait(timeout=probe_timeout)
-                out.seek(0)
-                err.seek(0)
-                if rc == 0:
-                    lines = out.read().strip().splitlines()
-                    if lines:
-                        return lines[-1]
-                    last = "probe printed nothing"
-                else:
-                    last = (err.read().strip().splitlines() or ["rc=%d" % rc])[-1]
-            except subprocess.TimeoutExpired:
-                import signal
-
-                try:
-                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    p.kill()  # group signal denied: at least the child dies
-                try:
-                    p.wait(timeout=10)
-                except subprocess.TimeoutExpired:
-                    pass  # unreaped zombie beats an unbounded hang
-                last = f"probe hung >{probe_timeout:.0f}s (backend wedged?)"
-        if attempt < retries - 1:
-            delay = 5 * (2**attempt)
-            print(
-                f"# backend probe {attempt + 1}/{retries} failed ({last}); "
-                f"retrying in {delay}s",
-                file=sys.stderr,
-            )
-            time.sleep(delay)
-    print(
-        f"# TPU backend unavailable after {retries} probes ({last}); "
-        "falling back to XLA-on-CPU",
-        file=sys.stderr,
-    )
+    platform = None
+    while attempts < max_tries:
+        remaining = budget - (time.time() - t0)
+        if remaining <= 1:
+            break
+        attempts += 1
+        platform, last = _probe_once(min(per_probe, remaining))
+        if platform is not None:
+            break
+        # short fixed pause: a recovering tunnel sometimes needs a beat,
+        # but exponential backoff on a wedged chip just burns the round
+        remaining = budget - (time.time() - t0)
+        if attempts < max_tries and remaining > 3:
+            time.sleep(2)
+    record = {
+        "backend_probe": {
+            "platform": platform or "cpu",
+            "outcome": "ok" if platform else "fallback_cpu",
+            "attempts": attempts,
+            "elapsed_s": round(time.time() - t0, 1),
+            "budget_s": budget,
+            "last_error": last if platform is None else "",
+        }
+    }
+    print(json.dumps(record), file=sys.stderr)
+    if platform is not None:
+        return platform
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -155,23 +187,112 @@ def np_two_hop(a, h_dst, frontier):
     return len(out1) + len(out2), np.unique(out2), chk
 
 
-def run_bench(scale: float):
+def _run_host_dedup(a, h_dst, frontiers):
+    """Fused classed-hop pipeline: ONE device program per hop per
+    sub-batch, np.unique dedup between hops overlapped with the device's
+    async dispatch queue.  Returns (best seconds, edges, chks[int32],
+    last query's hop-2 unique set)."""
     import jax
     import jax.numpy as jnp
     from dgraph_tpu import ops
     from dgraph_tpu.ops.sets import SENT
 
-    n_nodes = max(1024, int(int(os.environ.get("BENCH_NODES", 2_000_000)) * scale))
-    n_edges = max(4096, int(int(os.environ.get("BENCH_EDGES", 21_000_000)) * scale))
-    n_seeds = max(64, int(int(os.environ.get("BENCH_SEEDS", 4096)) * min(1.0, scale * 4)))
-    # 1000-query streams (VERDICT r4 next #1b): one lax.map dispatch
-    # serves the whole stream, so the ~70ms fixed dispatch overhead
-    # amortizes to noise; compile cost stays at the CHUNK_Q program size
-    # (planning + numpy baseline stay ~linear and well inside driver time)
-    iters = int(os.environ.get("BENCH_ITERS", 1000))
+    ce = ops.ClassedExpander(a.offsets, a.dst, a.h_offsets)
+    iters = len(frontiers)
 
-    t0 = time.time()
-    a = build_graph(n_nodes, n_edges)
+    # --- capacity planning (untimed): worst per-class composition over
+    # the stream, bucket_fine'd so one compiled program per hop serves
+    # every sub-batch ---
+    n_cls = ce.n_cls
+    c1w = np.ones(n_cls, np.int64)
+    c2w = np.ones(n_cls, np.int64)
+    h1w = e1w = h2w = e2w = 0
+    uniq1 = []
+    for f in frontiers:
+        c1, h1, e1 = ce.class_counts(f)
+        c1w = np.maximum(c1w, c1)
+        h1w, e1w = max(h1w, h1), max(e1w, e1)
+        f1 = np.unique(np_expand(a.h_offsets, h_dst, f))
+        uniq1.append(f1)
+        c2, h2, e2 = ce.class_counts(f1)
+        c2w = np.maximum(c2w, c2)
+        h2w, e2w = max(h2w, h2), max(e2w, e2)
+    caps1 = ce.plan_caps(c1w, h1w, e1w)
+    caps2 = ce.plan_caps(c2w, h2w, e2w)
+    hop1 = ce.program(caps1, "materialize", batched=True)
+    hop2 = ce.program(caps2, "checksum", batched=True)
+
+    def stack_partitions(queries, caps):
+        """Class-sort each query's rows and write the per-class slices
+        straight into stacked [B, cap_c] mats (-1 pad) — the host side
+        of one batched hop dispatch."""
+        B = len(queries)
+        mats = [np.full((B, c), -1, np.int32) for c in caps[:n_cls]]
+        mats.append(np.full((B, max(caps[n_cls], 1)), -1, np.int32))
+        for j, f in enumerate(queries):
+            rs, starts, _deg, _pos = ce.class_sort(f)
+            for k in range(n_cls + 1):
+                lo, hi = int(starts[k]), int(starts[k + 1])
+                if hi > lo:
+                    mats[k][j, : hi - lo] = rs[lo:hi]
+        return tuple(jnp.asarray(m) for m in mats)
+
+    # --- seed partitions (untimed prep, like frontier padding was) ---
+    SB = int(os.environ.get("BENCH_SUBBATCH", 50))
+    nb = -(-iters // SB)
+    seed_batches = [
+        stack_partitions(frontiers[b * SB: (b + 1) * SB], caps1)
+        for b in range(nb)
+    ]
+
+    def one_pass():
+        # dispatch every hop-1 sub-batch up front: jax dispatch is
+        # async, so the host's unique+partition work below overlaps the
+        # device working through its queue
+        futs = [hop1(mb, ()) for mb in seed_batches]
+        chks = np.empty(iters, np.int32)
+        edges = 0
+        for b, (lanes, t1) in enumerate(futs):
+            lanes = np.asarray(lanes)  # blocks for THIS sub-batch only
+            edges += int(np.asarray(t1).astype(np.int64).sum())
+            B = lanes.shape[0]
+            uniq = []
+            for j in range(B):
+                u = np.unique(lanes[j])
+                if len(u) and u[-1] == SENT:
+                    u = u[:-1]
+                uniq.append(u)
+            c, t2 = hop2(stack_partitions(uniq, caps2), ())
+            chks[b * SB: b * SB + B] = np.asarray(c)
+            edges += int(np.asarray(t2).astype(np.int64).sum())
+        return edges, chks
+
+    edges, chks = one_pass()  # warmup/compile
+    best = float("inf")
+    for _ in range(4):  # best-of-4: the shared chip's load swings runs ~1.5×
+        t0 = time.time()
+        edges, chks = one_pass()
+        best = min(best, time.time() - t0)
+
+    # untimed correctness artifact: the last query's full hop-2 set
+    last_prog = ce.program(caps2, "materialize")
+    pm, _pos = ce.partition(uniq1[-1], caps2)
+    lanes, _t = last_prog(tuple(jnp.asarray(m) for m in pm), ())
+    lanes = np.asarray(lanes)
+    last_set = np.unique(lanes)
+    last_set = last_set[last_set != SENT]
+    return best, edges, chks, last_set
+
+
+def _run_device_dedup(a, frontiers, fcap):
+    """One jitted program for the WHOLE 2-hop pipeline per query batch
+    (inline-head expansion + skey-grouped sort dedup): the TPU path,
+    where the sort rides the VPU and the frontier never leaves HBM."""
+    import jax
+    import jax.numpy as jnp
+    from dgraph_tpu import ops
+    from dgraph_tpu.ops.sets import SENT
+
     h_dst = np.asarray(a.dst)[: a.n_edges]
     try:
         metap, ov_chunks = a.inline_layout_grouped()
@@ -181,26 +302,18 @@ def run_bench(scale: float):
         metap, ov_chunks = a.inline_layout()
         grouped = False
         mask = SENT  # identity decode
-    build_s = time.time() - t0
-
     deg_of = (a.h_offsets[1:] - a.h_offsets[:-1]).astype(np.int64)
-    rng = np.random.default_rng(3)
-    frontiers = []
-    for _ in range(iters):
-        f = np.unique(rng.integers(1, n_nodes + 1, size=n_seeds))
-        if grouped:
-            # group-order the seed frontier exactly like the device dedup
-            # orders hop-1 output: overflow-bearing rows first, ascending
-            # — hop 1 then shares the short-slot-map path (ops.skey_encode)
+    if grouped:
+        # group-order each seed frontier exactly like the device dedup
+        # orders hop-1 output: overflow-bearing rows first, ascending —
+        # hop 1 then shares the short-slot-map path (ops.skey_encode)
+        gfronts = []
+        for f in frontiers:
             key = np.asarray(ops.skey_encode(f, deg_of[f] > ops.INLINE))
-            f = f[np.argsort(key, kind="stable")]
-        frontiers.append(f)
+            gfronts.append(f[np.argsort(key, kind="stable")])
+    else:
+        gfronts = frontiers
 
-    # plan static overflow-chunk caps from the worst case so one
-    # compilation serves all; 1/8-step buckets (bucket_fine) because the
-    # whole batch runs as one program — pow2 padding would tax every
-    # capacity-proportional cost up to 2×.  pcaps bound the GROUPED
-    # productive prefixes (rows with overflow chunks).
     worst1 = worst2 = worstu = wp1 = wp2 = 1
     for f in frontiers:
         c1 = int(a.ov_chunk_degree_of_rows(f).sum())
@@ -211,32 +324,18 @@ def run_bench(scale: float):
         wp1 = max(wp1, int((deg_of[f] > ops.INLINE).sum()))
         wp2 = max(wp2, int((deg_of[f1] > ops.INLINE).sum()))
     capo1, capo2 = ops.bucket_fine(worst1), ops.bucket_fine(worst2)
-    ucap = ops.bucket_fine(worstu)  # tight row capacity for the deduped frontier
-    fcap = ops.bucket(max(len(f) for f in frontiers))
+    ucap = ops.bucket_fine(worstu)
     if grouped:
         pcap1, pcap2 = ops.bucket_fine(wp1), min(ops.bucket_fine(wp2), ucap)
     else:  # ungrouped rows: the slot-map must span every row
         pcap1, pcap2 = fcap, ucap
 
-    # BENCH_PALLAS=1 swaps the overflow slot-map for the Pallas kernel
-    # (ops/pallas_slotmap.py — ROOFLINE Path-onward #2); the watch loop
-    # A/Bs both and banks the better TPU number.  Grouped layouts only:
-    # the kernel's window-max shortcut needs the productive-prefix
-    # invariant that skey ordering provides.
     expander = (
         ops.expand_inline_grouped_pallas
         if os.environ.get("BENCH_PALLAS") == "1" and grouped
         else ops.expand_inline_grouped
     )
 
-    # ONE device dispatch for the whole query batch.  Per query the
-    # pipeline is the inline-head expansion (ops.expand_inline_grouped):
-    # ONE 32-byte row gather serves a row's metadata AND its first INLINE
-    # targets (the gather-engine index rate is the measured wall,
-    # docs/ROOFLINE.md); only degree>INLINE rows touch overflow chunks.
-    # Stored targets are skey-coded, so the dedup sort doubles as the
-    # GROUPING pass: overflow-bearing rows land in an ascending prefix
-    # and the slot-map scan/scatter chain runs on pcap2 rows, not ucap.
     def one_query(frontier):
         rows0 = ops.frontier_rows(frontier)
         inl1, ov1, t1 = expander(metap, ov_chunks, rows0, capo1, pcap1)
@@ -246,19 +345,12 @@ def run_bench(scale: float):
         rows1 = jnp.where(f1 == SENT, -1, f1 & mask)
         inl2, ov2, t2 = expander(metap, ov_chunks, rows1, capo2, pcap2)
         # checksum over every produced uid (skey-decoded): forces each
-        # query's output to actually materialize (otherwise XLA could DCE
-        # all but the last query's gathers, and "edges traversed" would
-        # be a lie)
+        # query's output to actually materialize
         chk = jnp.sum(
             jnp.where(inl2 == SENT, 0, inl2 & mask), dtype=jnp.int32
         ) + jnp.sum(jnp.where(ov2 == SENT, 0, ov2 & mask), dtype=jnp.int32)
         return chk, t1 + t2, (inl2, ov2)
 
-    # one dispatch serves the whole stream: vmap batches CHUNK_Q queries
-    # into one program (lockstep ops amortize per-op overhead), lax.map
-    # loops sub-batches inside the SAME dispatch — compile cost stays at
-    # the 200-query program size while per-dispatch fixed overhead
-    # (host round trip + queueing) amortizes over every query
     CHUNK_Q = 200
 
     @jax.jit
@@ -272,7 +364,7 @@ def run_bench(scale: float):
         g = frontiers_mat.shape[0] // CHUNK_Q
         sub = frontiers_mat[: g * CHUNK_Q].reshape(g, CHUNK_Q, -1)
         chks, counts = jax.lax.map(jax.vmap(q), sub)
-        rest = frontiers_mat[g * CHUNK_Q :]
+        rest = frontiers_mat[g * CHUNK_Q:]
         if rest.shape[0]:
             ct, cc = jax.vmap(q)(rest)
             return (
@@ -283,28 +375,64 @@ def run_bench(scale: float):
 
     @jax.jit
     def last_query_set(frontier):
-        # last query's full result set for the correctness cross-check —
-        # a SEPARATE untimed program (keeping every query's outputs as
-        # program outputs would pin iters*(ucap*INLINE + capo2*CHUNK)*4
-        # bytes of HBM; the per-query checksums already force
-        # materialization inside the timed batch)
         _c, _t, (inl2, ov2) = one_query(frontier)
         return ops.sort_unique(jnp.concatenate([inl2.reshape(-1), ov2.reshape(-1)]))
 
-    fmat = jnp.asarray(np.stack([ops.pad_to(f, fcap) for f in frontiers]))
-
+    fmat = jnp.asarray(np.stack([ops.pad_to(f, fcap) for f in gfronts]))
     chks, counts = run_batch(fmat)  # warmup/compile
     np.asarray(counts)
-
-    dev_s = float("inf")
-    for _ in range(4):  # best-of-4: the shared chip's load swings runs ~1.5×
+    best = float("inf")
+    for _ in range(4):
         t0 = time.time()
         chks, counts = run_batch(fmat)
         counts = np.asarray(counts)  # sync
         np.asarray(chks)
-        dev_s = min(dev_s, time.time() - t0)
-    dev_edges = int(counts.sum())
-    last_f2 = last_query_set(fmat[-1])
+        best = min(best, time.time() - t0)
+    edges = int(counts.sum())
+    got = np.asarray(last_query_set(fmat[-1]))
+    last_set = np.sort(got[got != SENT] & mask)
+    last_set = np.unique(last_set)
+    return best, edges, np.asarray(chks), last_set
+
+
+def run_bench(scale: float):
+    import jax
+
+    n_nodes = max(1024, int(int(os.environ.get("BENCH_NODES", 2_000_000)) * scale))
+    n_edges = max(4096, int(int(os.environ.get("BENCH_EDGES", 21_000_000)) * scale))
+    n_seeds = max(64, int(int(os.environ.get("BENCH_SEEDS", 4096)) * min(1.0, scale * 4)))
+    iters = int(os.environ.get("BENCH_ITERS", 1000))
+
+    t0 = time.time()
+    a = build_graph(n_nodes, n_edges)
+    h_dst = np.asarray(a.dst)[: a.n_edges]
+    build_s = time.time() - t0
+
+    rng = np.random.default_rng(3)
+    frontiers = [
+        np.unique(rng.integers(1, n_nodes + 1, size=n_seeds))
+        for _ in range(iters)
+    ]
+    from dgraph_tpu import ops
+
+    fcap = ops.bucket(max(len(f) for f in frontiers))
+
+    platform = jax.devices()[0].platform
+    dedup = os.environ.get("BENCH_DEDUP", "auto")
+    if dedup == "auto":
+        # host-side np.unique between hops wins wherever XLA's sort
+        # loses to numpy's (everywhere but TPU, measured ~10×); on TPU
+        # the sort rides the VPU and staying device-resident wins
+        dedup = "device" if platform == "tpu" else "host"
+
+    if dedup == "host":
+        dev_s, dev_edges, chks, last_set = _run_host_dedup(
+            a, h_dst, frontiers
+        )
+    else:
+        dev_s, dev_edges, chks, last_set = _run_device_dedup(
+            a, frontiers, fcap
+        )
 
     # best-of-2 for the CPU baseline: the shared host's load swings numpy
     # throughput ~2x between runs; compare against its fastest
@@ -320,13 +448,10 @@ def run_bench(scale: float):
         cpu_s = min(cpu_s, time.time() - t0)
 
     # correctness cross-check: per-query checksums + the last frontier set
-    # (device values are skey-coded: decode and re-sort before comparing)
     _, want, _ = np_two_hop(a, h_dst, frontiers[-1])
-    got = np.asarray(last_f2)
-    got = np.sort(got[got != SENT] & mask)
-    assert np.array_equal(got, want), "device 2-hop != numpy reference"
+    assert np.array_equal(last_set, want), "device 2-hop != numpy reference"
     assert dev_edges == cpu_edges, (dev_edges, cpu_edges)
-    assert np.array_equal(np.asarray(chks), np.array(cpu_chks, dtype=np.int32)), (
+    assert np.array_equal(chks, np.array(cpu_chks, dtype=np.int32)), (
         "per-query device checksums != numpy"
     )
 
@@ -342,7 +467,12 @@ def run_bench(scale: float):
                 # self-describing record: a wedged-TPU round falls back to
                 # XLA-on-CPU (see ensure_backend) and must not read as a
                 # TPU measurement
-                "platform": jax.devices()[0].platform,
+                "platform": platform,
+                # the batched fused-hop executor (ops/batch.py) served
+                # every traversal: one device program per hop (host
+                # dedup) or per 2-hop batch (device dedup)
+                "fused_hop": True,
+                "hop_dedup": dedup,
                 "pallas_slotmap": os.environ.get("BENCH_PALLAS") == "1",
             }
         )
@@ -350,8 +480,8 @@ def run_bench(scale: float):
     print(
         f"# graph: {n_nodes} nodes / {a.n_edges} edges (build {build_s:.1f}s); "
         f"{iters} queries x {n_seeds} seeds; device {dev_s:.2f}s "
-        f"({dev_eps/1e6:.1f}M e/s) vs numpy {cpu_s:.2f}s ({cpu_eps/1e6:.1f}M e/s) "
-        f"on {jax.devices()[0].platform}; scale={scale:g}",
+        f"({dev_eps/1e6:.1f}M e/s, {dedup} dedup) vs numpy {cpu_s:.2f}s "
+        f"({cpu_eps/1e6:.1f}M e/s) on {platform}; scale={scale:g}",
     )
 
 

@@ -6,7 +6,15 @@ Measures, at headline-bench-like shapes (200-query batches):
   - expand_inline_grouped_pallas (Pallas slot-map; interpret off-TPU)
   - sort_unique dedup at the hop-2 width
   - member_mask set membership
-One JSON line per kernel: {"kernel", "value", "unit", "platform"}.
+plus the BATCHED-vs-PER-OP comparison for the fused hop executor
+(ops/batch.py): for B ∈ {1, 64, 1024} and L ∈ {256, 4096}, one fused
+``expand_filter_compact`` program per hop versus the per-op dispatch
+sequence (expand, merge, one intersect per predicate, compact), with
+DISPATCH AND COMPILE COUNTS recorded per path — the dispatch ratio is
+the fusion win the headline bench banks.
+
+One JSON line per measurement: {"kernel", "value", "unit", "platform",
+...extras}.
 
 Usage: python bench_ops.py    (env: BO_NODES/BO_EDGES/BO_Q scale it;
 same wedged-TPU probe contract as bench.py)
@@ -19,6 +27,142 @@ import time
 import numpy as np
 
 
+class DispatchCounter:
+    """Counts device dispatches (one per jitted-callable invocation from
+    the host, via ``call``) and XLA compiles (via the jax.monitoring
+    backend_compile event) while active.
+
+    jax.monitoring offers register but no unregister, so ONE module
+    listener dispatches to whichever counter is currently active —
+    entering N counters over a run must not accumulate N live closures.
+    """
+
+    _active = None
+    _listener_installed = False
+
+    def __init__(self):
+        self.dispatches = 0
+        self.compiles = 0
+
+    @classmethod
+    def _install_listener(cls):
+        if cls._listener_installed:
+            return
+        import jax
+
+        def on_event(event, duration, **kw):
+            c = cls._active
+            if c is not None and event.endswith("backend_compile_duration"):
+                c.compiles += 1
+
+        jax.monitoring.register_event_duration_secs_listener(on_event)
+        cls._listener_installed = True
+
+    def __enter__(self):
+        type(self)._install_listener()
+        type(self)._active = self
+        return self
+
+    def __exit__(self, *exc):
+        type(self)._active = None
+        return False
+
+    def call(self, fn, *args, **kw):
+        """Invoke a jitted callable, counting it as ONE device dispatch."""
+        self.dispatches += 1
+        return fn(*args, **kw)
+
+
+def bench_batched_vs_per_op(platform, emit):
+    """The fused-hop dispatch-count comparison: a hop with K filter
+    predicates as ONE fused program vs the per-op dispatch sequence the
+    pre-fusion engine issued."""
+    import jax
+    import jax.numpy as jnp
+
+    from dgraph_tpu import ops
+    from bench import build_graph
+
+    n_nodes = int(os.environ.get("BO_NODES2", 100_000))
+    n_edges = int(os.environ.get("BO_EDGES2", 800_000))
+    a = build_graph(n_nodes, n_edges)
+    rng = np.random.default_rng(11)
+    K = 4  # filter predicates per hop
+
+    merge_op = ops.sort_unique_batch
+    intersect_op = ops.intersect_batch
+    compact_op = jax.jit(jax.vmap(ops.compact))
+
+    keep_np = [
+        np.unique(rng.integers(1, n_nodes + 1, size=n_nodes // 8))
+        for _ in range(K)
+    ]
+    keeps = tuple(
+        jnp.asarray(ops.pad_to(k, ops.bucket(len(k)))) for k in keep_np
+    )
+
+    for B in (1, 64, 1024):
+        for L in (256, 4096):
+            seeds = [
+                np.unique(rng.integers(1, n_nodes + 1, size=max(4, L // 8)))
+                for _ in range(B)
+            ]
+            cap = ops.bucket(
+                max(int(a.degree_of_rows(s).sum()) for s in seeds)
+            )
+            rows = jnp.asarray(np.stack([ops.pad_rows(s, L) for s in seeds]))
+            # per-op building block: its own jitted dispatch per call
+            expand_op = jax.jit(jax.vmap(
+                lambda r: ops.expand_ascending(a.offsets, a.dst, r, cap)[0]
+            ))
+            keeps_b = tuple(
+                jnp.broadcast_to(k, (B,) + k.shape) for k in keeps
+            )
+
+            # fused: ONE program for the whole hop
+            with DispatchCounter() as cf:
+                r = cf.call(
+                    ops.expand_filter_compact_batch,
+                    a.offsets, a.dst, rows, cap, keeps,
+                )
+                jax.block_until_ready(r)
+                compiles = cf.compiles
+                t0 = time.time()
+                r = cf.call(
+                    ops.expand_filter_compact_batch,
+                    a.offsets, a.dst, rows, cap, keeps,
+                )
+                jax.block_until_ready(r)
+                fused_s = time.time() - t0
+
+            # per-op: expand, merge, K intersects, compact — one
+            # dispatch each (the engine's pre-fusion shape)
+            def per_op(counter):
+                out = counter.call(expand_op, rows)
+                u = counter.call(merge_op, out)
+                for k in keeps_b:
+                    u = counter.call(intersect_op, u, k)
+                return counter.call(compact_op, u)
+
+            with DispatchCounter() as cp:
+                jax.block_until_ready(per_op(cp))
+                n0 = cp.dispatches
+                t0 = time.time()
+                jax.block_until_ready(per_op(cp))
+                per_op_s = time.time() - t0
+                per_dispatches = cp.dispatches - n0
+
+            emit("fused_hop_vs_per_op", per_op_s / fused_s, "x speedup", {
+                "B": B, "L": L, "predicates": K,
+                "fused_dispatches_per_hop": 1,
+                "per_op_dispatches_per_hop": per_dispatches,
+                "dispatch_ratio": float(per_dispatches),
+                "fused_compiles": compiles,
+                "fused_s": round(fused_s, 4),
+                "per_op_s": round(per_op_s, 4),
+            })
+
+
 def main():
     from bench import ensure_backend
 
@@ -29,6 +173,17 @@ def main():
     from dgraph_tpu import ops
     from dgraph_tpu.ops.sets import SENT
     from bench import build_graph
+
+    def emit(kernel, value, unit, extra=None):
+        rec = {
+            "kernel": kernel, "value": round(value, 1), "unit": unit,
+            "platform": platform,
+        }
+        if extra:
+            rec.update(extra)
+        print(json.dumps(rec), flush=True)
+
+    bench_batched_vs_per_op(platform, emit)
 
     n_nodes = int(os.environ.get("BO_NODES", 500_000))
     n_edges = int(os.environ.get("BO_EDGES", 4_000_000))
@@ -63,12 +218,6 @@ def main():
             jax.block_until_ready(fn())
             b = min(b, time.time() - t0)
         return b
-
-    def emit(kernel, value, unit):
-        print(json.dumps({
-            "kernel": kernel, "value": round(value, 1), "unit": unit,
-            "platform": platform,
-        }), flush=True)
 
     for name, expander in (
         ("expand_inline_grouped", ops.expand_inline_grouped),

@@ -366,7 +366,7 @@ class CSRArena:
         self._inline_grouped = None
         self._lut = None
         self._n_distinct_dst = None
-        for attr in ("_topm_cdeg", "_topm_ovdeg"):
+        for attr in ("_topm_cdeg", "_topm_ovdeg", "_topm_deg", "_classed"):
             if hasattr(self, attr):
                 delattr(self, attr)
         self._device_stale = True

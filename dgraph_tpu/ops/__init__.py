@@ -46,4 +46,17 @@ from dgraph_tpu.ops.order import (  # noqa: F401
     gather_ranks,
     segmented_sort_perm,
 )
+from dgraph_tpu.ops.batch import (  # noqa: F401
+    ClassedExpander,
+    classed_for_arena,
+    difference_batch,
+    expand_ascending,
+    expand_filter_compact,
+    expand_filter_compact_batch,
+    intersect_batch,
+    member_mask_batch,
+    multi_hop,
+    sort_unique_batch,
+    union_many_batch,
+)
 from dgraph_tpu.ops import ref  # noqa: F401

@@ -1,0 +1,563 @@
+"""Batched, fused frontier execution: one device program per hop.
+
+The per-op engine path (ops/sets.py consumed one `jax.jit` dispatch at a
+time from query/engine.py) pays one device round trip per *set
+operation*: a 2-hop traversal with multi-predicate filters dispatches
+O(predicates × levels × queries) programs.  EmptyHeaded (PAPERS.md)
+compiles whole multi-way join plans into one fused kernel instead of
+composing pairwise ops; RedisGraph/GraphBLAS batches traversal into
+single matrix-style operations.  This module is that shape for the
+dgraph-tpu ops layer:
+
+- **Batched set ops** (`intersect_batch`, `union_many_batch`,
+  `difference_batch`, `member_mask_batch`, `sort_unique_batch`): the
+  ``[B, L]`` vmapped variants of the scalar sorted-unique-padded kernels
+  — one dispatch for a whole batch of frontiers instead of B.
+- **`expand_ascending`**: dense CSR expansion for ASCENDING-DISTINCT row
+  vectors via the telescoped slot map (one scatter + one prefix sum —
+  the scalar analog of ops.expand_chunked's chunk map).  Output is
+  densely packed (valid prefix, SENT tail), which makes the follow-up
+  dedup sort as narrow as it can be.
+- **`expand_filter_compact`**: gather → k-way merge → multi-predicate
+  intersect → compact in ONE jitted program (plus its vmapped batch
+  form).  The per-op path for the same hop is ≥ (2 + n_predicates)
+  dispatches; bench_ops.py measures the ratio.
+- **Degree-classed hop programs** (`ClassedExpander`): a scatter- and
+  sort-free expansion for backends where XLA's scatter/sort lag far
+  behind its gathers (measured on XLA-on-CPU: scatter ≈ 100ns/update
+  and sort ≈ 10× numpy, while gathers run at memcpy-like rates).  Rows
+  are partitioned by ⌈log2(degree)⌉ into classes; class c expands as a
+  pure 2-D gather ``dst[o0[:, None] + iota(2^c)]`` masked by degree —
+  no slot map at all.  Degree > ``2^LOG_W_MAX`` rows fall into a dense
+  residual bucket served by `expand_ascending`.  Capacities reuse the
+  `bucket_fine` scheme so the jit cache stays bounded (one program per
+  bucketed capacity tuple — tests/test_batch_ops.py asserts the bound).
+- **`multi_hop`**: a `lax.scan` multi-hop driver that keeps the
+  frontier (and optionally the visited set) device-resident across
+  hops, with donated carry buffers — no host round trip between levels.
+
+Layout contract: everything here speaks the sorted-unique-padded dialect
+of ops/sets.py (see docs/sets-contract.md).  The batch axis is always
+leading: a ``[B, L]`` matrix is B independent uid sets, padded with SENT
+to the shared capacity L.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgraph_tpu.ops.sets import (
+    SENT,
+    bucket,
+    bucket_fine,
+    frontier_rows,
+    member_mask,
+    sort_desc_free,
+    sort_unique,
+)
+
+# widest per-row gather class: rows with degree above 2^LOG_W_MAX go to
+# the dense residual bucket (a handful of celebrity rows must not force
+# a megalane class matrix on everyone)
+LOG_W_MAX = 10
+
+
+# -- batched set ops ---------------------------------------------------------
+# vmapped at module level so the jit cache holds ONE program per (B, L)
+# bucket, not one per call site.
+
+intersect_batch = jax.jit(jax.vmap(lambda a, b: sort_desc_free(
+    jnp.where(member_mask(a, b), a, SENT))))
+"""[B, L] ∩ [B, L] rowwise (result shaped like ``a``): one dispatch."""
+
+difference_batch = jax.jit(jax.vmap(lambda a, b: sort_desc_free(
+    jnp.where((~member_mask(a, b)) & (a != SENT), a, SENT))))
+"""[B, L] \\ [B, L] rowwise: one dispatch."""
+
+union_many_batch = jax.jit(
+    jax.vmap(lambda mat: sort_unique(mat.reshape(-1)))
+)
+"""[B, K, L] → [B, K*L]: K-way union per batch row, one dispatch."""
+
+member_mask_batch = jax.jit(jax.vmap(member_mask))
+"""[B, L] probed against [B, Ls] rowwise: one dispatch."""
+
+sort_unique_batch = jax.jit(jax.vmap(sort_unique))
+"""Rowwise sort + dedup of a [B, L] batch: one dispatch."""
+
+
+# -- dense ascending-row expansion ------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def expand_ascending(
+    offsets: jnp.ndarray, dst: jnp.ndarray, rows: jnp.ndarray, cap: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """CSR expansion of an ASCENDING-DISTINCT row vector (-1 skips
+    anywhere) into a densely packed target vector.
+
+    The slot→edge map telescopes exactly like ops.expand_chunked's
+    chunk map: scatter ``o0_j - prev_end_j`` at each productive row's
+    output start, prefix-sum, add the slot iota — one scatter + one
+    O(cap) prefix sum, then a single dst gather per slot.  (Ascending
+    rows make the productive ends monotone, which is what lets cummax
+    stand in for "previous productive row's end".)
+
+    Returns (out int32[cap] — valid prefix then SENT tail — and the
+    valid count).  Unlike expand_csr the output carries no per-slot
+    owner; callers that need the uid matrix keep expand_csr /
+    expand_inline_seg.
+    """
+    valid = rows >= 0
+    r = jnp.where(valid, rows, 0)
+    o0 = offsets[r]
+    deg = jnp.where(valid, offsets[r + 1] - o0, 0)
+    o0 = jnp.where(valid, o0, 0)
+    cum = jnp.cumsum(deg)
+    out_start = cum - deg
+    productive = deg > 0
+    end = jnp.where(productive, o0 + deg, 0)
+    pe = jnp.concatenate(
+        [jnp.zeros((1,), end.dtype), jax.lax.cummax(end)[:-1]]
+    )
+    slot = jnp.where(productive, out_start, cap)
+    dvec = (
+        jnp.zeros((cap,), jnp.int32)
+        .at[slot]
+        .set(jnp.where(productive, o0 - pe, 0).astype(jnp.int32), mode="drop")
+    )
+    i = jnp.arange(cap, dtype=jnp.int32)
+    edge = jnp.cumsum(dvec) + i
+    ok = i < cum[-1]
+    out = jnp.where(ok, dst[jnp.clip(edge, 0, dst.shape[0] - 1)], SENT)
+    return out, cum[-1].astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cap", "cap_out"))
+def expand_filter_compact(
+    offsets: jnp.ndarray,
+    dst: jnp.ndarray,
+    rows: jnp.ndarray,
+    cap: int,
+    keeps: Tuple[jnp.ndarray, ...] = (),
+    cap_out: Optional[int] = None,
+):
+    """One fused program for a whole hop: CSR gather → k-way merge →
+    multi-predicate intersect → compact.
+
+    ``keeps`` is a tuple of sorted-unique-padded uid keep-sets (one per
+    fused filter predicate), applied as member_mask's before the merge
+    so masked lanes never survive into the dedup sort.  The per-op
+    equivalent is (2 + len(keeps)) separate dispatches: expand, one
+    intersect per keep, then sort_unique — bench_ops.py measures both.
+
+    Returns (frontier int32[cap_out or cap] sorted-unique-padded,
+    total int32 — raw edge count BEFORE filtering, the traversal work).
+    """
+    out, total = expand_ascending(offsets, dst, rows, cap)
+    for k in keeps:
+        out = jnp.where(member_mask(out, k), out, SENT)
+    u = sort_unique(out)
+    if cap_out is not None:
+        u = u[:cap_out]
+    return u, total
+
+
+def expand_filter_compact_batch(
+    offsets: jnp.ndarray,
+    dst: jnp.ndarray,
+    rows: jnp.ndarray,
+    cap: int,
+    keeps: Tuple[jnp.ndarray, ...] = (),
+    cap_out: Optional[int] = None,
+):
+    """[B, R] batched expand_filter_compact — ONE dispatch for the whole
+    batch of frontiers (keeps broadcast across the batch)."""
+    return _effc_batch(offsets, dst, rows, cap, keeps, cap_out)
+
+
+@partial(jax.jit, static_argnames=("cap", "cap_out"))
+def _effc_batch(offsets, dst, rows, cap, keeps, cap_out):
+    def one(r):
+        return expand_filter_compact(offsets, dst, r, cap, keeps, cap_out)
+
+    return jax.vmap(one)(rows)
+
+
+# -- multi-hop scan driver ---------------------------------------------------
+
+
+def multi_hop(
+    offsets: jnp.ndarray,
+    dst: jnp.ndarray,
+    frontier: jnp.ndarray,
+    visited: jnp.ndarray,
+    n_hops: int,
+    cap: int,
+    track_visited: bool = False,
+    lut: Optional[jnp.ndarray] = None,
+):
+    """lax.scan multi-hop driver: the frontier stays device-resident
+    across hops; the (frontier, visited) carry buffers are DONATED so
+    XLA reuses them in place instead of allocating per hop.
+
+    Every hop shares one capacity ``cap`` (both the expansion width and
+    the frontier width — lax.scan requires a uniform carry shape), so
+    callers plan cap from the worst level.  Rows are frontier uids
+    themselves (dense arenas: row i == uid i) unless ``lut`` maps
+    uid → arena row (-1 for rowless uids, arena.lut layout).
+
+    With ``track_visited`` the walk is level-synchronous BFS: each hop's
+    output drops already-visited uids (the reachMap dedup of
+    query/recurse.go:110-145) and joins the visited set.
+
+    frontier: int32[cap] sorted-unique-padded; visited: int32[cap]
+    (ignored unless track_visited).  Returns (frontiers int32[n_hops,
+    cap] — the post-dedup frontier ENTERING hop i+1 —, edge counts
+    int32[n_hops], final visited int32[cap]).
+    """
+    import warnings
+
+    with warnings.catch_warnings():
+        # backends that cannot alias a given carry (e.g. the untouched
+        # visited buffer when track_visited=False, or XLA-CPU outputs)
+        # warn per compiled shape; donation is best-effort by design
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        return _multi_hop_jit(
+            offsets, dst, frontier, visited, n_hops, cap, track_visited, lut
+        )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_hops", "cap", "track_visited"),
+    donate_argnums=(2, 3),
+)
+def _multi_hop_jit(
+    offsets, dst, frontier, visited, n_hops, cap, track_visited, lut
+):
+    def body(carry, _):
+        f, vis = carry
+        if lut is None:
+            rows = frontier_rows(f)
+        else:
+            rows = jnp.where(
+                (f >= 0) & (f < lut.shape[0]) & (f != SENT),
+                lut[jnp.clip(f, 0, lut.shape[0] - 1)],
+                -1,
+            )
+        out, total = expand_ascending(offsets, dst, rows, cap)
+        nxt = sort_unique(out)
+        if track_visited:
+            nxt = sort_desc_free(
+                jnp.where(member_mask(nxt, vis), SENT, nxt)
+            )
+            vis = sort_unique(jnp.concatenate([vis, nxt]))[:cap]
+        return (nxt, vis), (nxt, total)
+
+    (f, vis), (fs, totals) = jax.lax.scan(
+        body, (frontier, visited), None, length=n_hops
+    )
+    return fs, totals, vis
+
+
+# -- degree-classed hop programs --------------------------------------------
+
+
+class ClassedExpander:
+    """Scatter/sort-free batched hop programs over one CSR arena.
+
+    Host side, rows partition by degree class (`partition`); device
+    side, each class is a pure 2-D gather masked by degree.  Programs
+    cache per (mode, bucketed capacity tuple, batched) — capacities ride
+    the bucket_fine scheme, so a steady workload compiles a handful of
+    programs total, then reuses them (the jit-cache bound that
+    tests/test_batch_ops.py::test_program_cache_bound locks in).
+
+    Construct once per arena from its device tensors + host offsets
+    mirror; the object is cheap, the cached programs are the asset.
+    """
+
+    def __init__(
+        self,
+        offsets: jnp.ndarray,
+        dst: jnp.ndarray,
+        h_offsets: np.ndarray,
+    ):
+        self.offsets = offsets
+        self.dst = dst
+        self.h_deg = np.asarray(
+            h_offsets[1:] - h_offsets[:-1], dtype=np.int64
+        )
+        maxdeg = int(self.h_deg.max()) if len(self.h_deg) else 0
+        self.n_cls = min(
+            max(1, int(np.ceil(np.log2(max(2, maxdeg)))) + 1), LOG_W_MAX + 1
+        )
+        self.widths = [1 << c for c in range(self.n_cls)]
+        self._programs: Dict[tuple, object] = {}
+
+    # -- host planning ------------------------------------------------------
+
+    def cls_of(self, deg: np.ndarray) -> np.ndarray:
+        """Class index per degree: ⌈log2(deg)⌉ clamped to the class
+        count; degree > 2^LOG_W_MAX means class n_cls (heavy).  Loop of
+        vector compares, not a [n, n_cls] broadcast — this runs per
+        query on the bench's hot host path."""
+        deg = np.asarray(deg)
+        c = np.zeros(deg.shape, np.int64)
+        for t in range(self.n_cls - 1):
+            c += deg > (1 << t)
+        if self.n_cls == LOG_W_MAX + 1:  # heavy rows possible
+            c = np.where(deg > (1 << (self.n_cls - 1)), self.n_cls, c)
+        return c
+
+    def class_sort(
+        self, rows: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Stable class-partition of a row vector: returns (rows sorted
+        class-major — ascending within each class —, starts int64[
+        n_cls+2] class boundaries, degrees aligned with the sorted rows,
+        original positions aligned with the sorted rows).  Negative and
+        degree-0 rows drop (they contribute no edges)."""
+        rows = np.asarray(rows)
+        pos0 = np.arange(len(rows))
+        keep = rows >= 0
+        rows, pos0 = rows[keep], pos0[keep]
+        deg = self.h_deg[rows]
+        keep = deg > 0
+        rows, pos0, deg = rows[keep], pos0[keep], deg[keep]
+        c = self.cls_of(deg)
+        order = np.argsort(c, kind="stable")
+        counts = np.bincount(c, minlength=self.n_cls + 1)
+        starts = np.zeros(self.n_cls + 2, dtype=np.int64)
+        np.cumsum(counts, out=starts[1:])
+        return rows[order], starts, deg[order], pos0[order]
+
+    def class_counts(self, rows: np.ndarray) -> Tuple[np.ndarray, int, int]:
+        """(per-class row counts, heavy row count, heavy edge total) for
+        one frontier — the inputs to `plan_caps`.  Negative rows skip."""
+        rows = np.asarray(rows)
+        rows = rows[rows >= 0]
+        deg = self.h_deg[rows]
+        deg = deg[deg > 0]
+        c = self.cls_of(deg)
+        counts = np.bincount(c, minlength=self.n_cls + 1)
+        heavy = counts[self.n_cls]
+        n_heavy = int(heavy)
+        heavy_edges = int(deg[c == self.n_cls].sum()) if n_heavy else 0
+        return counts[: self.n_cls], n_heavy, heavy_edges
+
+    def plan_caps(
+        self, counts: np.ndarray, n_heavy: int, heavy_edges: int,
+        fine: bool = True,
+    ) -> tuple:
+        """Bucket worst-case per-class row counts (+ heavy bucket) into
+        the static capacity tuple that keys the compiled program.
+
+        ``fine`` uses 1/8-step buckets — right when ONE plan serves a
+        long batch (bench.py plans the worst composition over the whole
+        stream once).  Per-query planning (engine per-level path) MUST
+        use fine=False: pow2 buckets, or the per-class combinatorics
+        compile a fresh program for every frontier wiggle."""
+        b = bucket_fine if fine else bucket
+        caps = tuple(int(b(max(1, int(c)), floor=8)) for c in counts)
+        hr = int(bucket(max(1, n_heavy), floor=8)) if n_heavy else 0
+        he = int(b(max(1, heavy_edges))) if n_heavy else 0
+        return caps + (hr, he)
+
+    def partition(
+        self, rows: np.ndarray, caps: tuple
+    ) -> Tuple[tuple, List[np.ndarray]]:
+        """Split an ascending-distinct row vector into per-class padded
+        mats (-1 pad) + the heavy-row mat.  Returns (mats, positions):
+        positions[c] = each class row's index in the INPUT vector, for
+        matrix reassembly.  Rows with degree 0 (or negative) are
+        dropped — they contribute no edges."""
+        rs, starts, _deg, pos = self.class_sort(rows)
+        mats = []
+        positions = []
+        for k in range(self.n_cls):
+            m = np.full(caps[k], -1, dtype=np.int32)
+            lo, hi = int(starts[k]), int(starts[k + 1])
+            m[: hi - lo] = rs[lo:hi]
+            mats.append(m)
+            positions.append(pos[lo:hi])
+        lo, hi = int(starts[self.n_cls]), int(starts[self.n_cls + 1])
+        hm = np.full(max(caps[self.n_cls], 1), -1, dtype=np.int32)
+        hm[: hi - lo] = rs[lo:hi]
+        mats.append(hm)
+        positions.append(pos[lo:hi])
+        return tuple(mats), positions
+
+    # -- device programs ----------------------------------------------------
+
+    def _build(self, caps: tuple, mode: str, batched: bool):
+        offsets, dst = self.offsets, self.dst
+        widths = self.widths
+        n_cls = self.n_cls
+        he_cap = caps[n_cls + 1]
+
+        def one(mats, keeps):
+            chk = jnp.int32(0)
+            total = jnp.int32(0)
+            parts = []
+            for k in range(n_cls):
+                w = widths[k]
+                r = mats[k]
+                lv = r >= 0
+                uc = jnp.where(lv, r, 0)
+                o0 = offsets[uc]
+                dg = jnp.where(lv, offsets[uc + 1] - o0, 0)
+                iot = jnp.arange(w, dtype=jnp.int32)
+                m = iot[None, :] < dg[:, None]
+                vals = dst[
+                    jnp.clip(o0[:, None] + iot[None, :], 0, dst.shape[0] - 1)
+                ]
+                total += jnp.sum(dg, dtype=jnp.int32)
+                vals = jnp.where(m, vals, SENT)
+                for s in keeps:
+                    vals = jnp.where(member_mask(vals, s), vals, SENT)
+                if mode == "checksum":
+                    chk += jnp.sum(
+                        jnp.where(vals == SENT, 0, vals), dtype=jnp.int32
+                    )
+                else:
+                    parts.append(vals.reshape(-1))
+            if he_cap:
+                hout, htot = expand_ascending(
+                    offsets, dst, mats[n_cls], he_cap
+                )
+                total += htot
+                for s in keeps:
+                    hout = jnp.where(member_mask(hout, s), hout, SENT)
+                if mode == "checksum":
+                    chk += jnp.sum(
+                        jnp.where(hout == SENT, 0, hout), dtype=jnp.int32
+                    )
+                else:
+                    parts.append(hout)
+            if mode == "checksum":
+                return chk, total
+            lanes = jnp.concatenate(parts)
+            if mode == "frontier":
+                return sort_unique(lanes), total
+            return lanes, total
+
+        if batched:
+            def run(mats, keeps):
+                return jax.vmap(lambda mm: one(mm, keeps))(mats)
+        else:
+            run = one
+        return jax.jit(run)
+
+    def program(
+        self, caps: tuple, mode: str = "materialize", batched: bool = False
+    ):
+        """Fetch-or-build the jitted hop program for a capacity tuple.
+
+        mode: "materialize" (flat SENT-masked lanes + edge total — the
+        engine's matrix source), "frontier" (sorted-unique next frontier
+        + total), or "checksum" (int32 wraparound sum of produced uids +
+        total; forces every edge to materialize without shipping lanes).
+        """
+        key = (caps, mode, batched)
+        p = self._programs.get(key)
+        if p is None:
+            p = self._build(caps, mode, batched)
+            self._programs[key] = p
+        return p
+
+    def lanes_of(self, caps: tuple) -> int:
+        """Flat lane count of a materialize-mode output for ``caps``."""
+        return sum(
+            caps[c] * self.widths[c] for c in range(self.n_cls)
+        ) + caps[self.n_cls + 1]
+
+    # -- single-frontier convenience (engine per-level path) ----------------
+
+    def expand_rows(
+        self, rows: np.ndarray, degs: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One-program expansion of an ascending-distinct row vector into
+        the engine's (out_flat int64, seg_ptr int64) uid-matrix layout.
+
+        One device dispatch + one fetch; reassembly into frontier order
+        happens host-side from the known per-row degrees (the same
+        O(edges) numpy accounting the packed CSR path already pays).
+        """
+        # ONE classification pass serves counts, caps and the mats —
+        # this runs per level on the hot path, so no re-derivation
+        rs, starts, deg_s, pos = self.class_sort(rows)
+        counts = np.diff(starts)[: self.n_cls]
+        hlo, hhi = int(starts[self.n_cls]), int(starts[self.n_cls + 1])
+        n_heavy = hhi - hlo
+        heavy_edges = int(deg_s[hlo:hhi].sum()) if n_heavy else 0
+        caps = self.plan_caps(counts, n_heavy, heavy_edges, fine=False)
+        mats = []
+        positions = []
+        for k in range(self.n_cls + 1):
+            lo, hi = int(starts[k]), int(starts[k + 1])
+            m = np.full(
+                max(caps[k], 1) if k == self.n_cls else caps[k],
+                -1, dtype=np.int32,
+            )
+            m[: hi - lo] = rs[lo:hi]
+            mats.append(m)
+            positions.append(pos[lo:hi])
+        prog = self.program(caps, mode="materialize")
+        lanes, _total = prog(
+            tuple(jnp.asarray(m) for m in mats), ()
+        )
+        lanes = np.asarray(lanes)
+        degs = np.asarray(degs)
+        n = len(rows)
+        seg_ptr = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(np.where(degs > 0, degs, 0), out=seg_ptr[1:])
+        out_flat = np.empty(int(seg_ptr[-1]), dtype=np.int64)
+        off = 0
+        for k in range(self.n_cls + 1):
+            w = self.widths[k] if k < self.n_cls else 0
+            pos = positions[k]
+            if k < self.n_cls:
+                blk = lanes[off: off + caps[k] * w].reshape(caps[k], w)
+                off += caps[k] * w
+                if not len(pos):
+                    continue
+                d = degs[pos]
+                m = np.arange(w)[None, :] < d[:, None]
+                vals = blk[: len(pos)][m]
+            else:
+                he_cap = caps[self.n_cls + 1]
+                blk = lanes[off: off + he_cap]
+                off += he_cap
+                if not len(pos):
+                    continue
+                d = degs[pos]
+                vals = blk[: int(d.sum())].astype(np.int64)
+            # scatter this class's per-row runs to their frontier slots
+            starts = seg_ptr[pos]
+            within = np.arange(int(d.sum())) - np.repeat(
+                np.cumsum(d) - d, d
+            )
+            out_flat[np.repeat(starts, d) + within] = vals
+        return out_flat, seg_ptr
+
+
+def classed_for_arena(arena) -> ClassedExpander:
+    """Lazily build (and cache on the arena object) the ClassedExpander
+    for a CSRArena — same lifetime pattern as arena.chunked()."""
+    arena.ensure_device()
+    ce = getattr(arena, "_classed", None)
+    if ce is None or ce.offsets is not arena.offsets:
+        # (re)build: apply_delta invalidates, and ensure_device swaps the
+        # device tensors — either way the cached programs are stale
+        ce = ClassedExpander(arena.offsets, arena.dst, arena.h_offsets)
+        arena._classed = ce
+    return ce

@@ -6,7 +6,10 @@ A *uid set* is an int32 vector, sorted ascending, with all padding slots
 holding ``SENT`` (int32 max).  Because the sentinel is the maximum value,
 padding always sorts to the end, so "compact the valid entries" is just a
 sort.  All kernels preserve this invariant: inputs and outputs are
-sorted-unique-padded unless documented otherwise.
+sorted-unique-padded unless documented otherwise.  The normative
+statement of the contract — including the ``[B, L]`` batch-axis layout
+of ops/batch.py, the row-vector (-1 skip) dialect of the expansion
+kernels, and the bucketing rules — lives in docs/sets-contract.md.
 
 Why this shape: the reference's algo layer (algo/uidlist.go:42-300 in
 /root/reference) walks variable-length sorted []uint64 slices with adaptive

@@ -216,6 +216,77 @@ def sharded_expand_segments(
     return out, seg_ptr
 
 
+@lru_cache(maxsize=64)
+def batched_hop_step(mesh: Mesh, cap: int, cap_out: int, n_hops: int):
+    """Data-parallel fused hop over a BATCH of frontiers: the [B, R]
+    query batch shards across the 'data' axis (each device owns a slice
+    of the queries), the arena replicates, and every device runs ONE
+    fused expand→merge→compact program per hop for its whole slice
+    (ops.expand_filter_compact) — the batch-axis counterpart of the
+    row-sharded expansion above, and the mesh entry of the batched
+    frontier executor (ops/batch.py).  Memoized per (mesh, caps, hops)
+    like sharded_expand_step, so serving paths reuse compiled programs.
+    """
+    from dgraph_tpu.ops.batch import expand_filter_compact
+
+    def local(offsets, dst, rows):
+        def one(r):
+            f = r
+            totals = []
+            for _ in range(n_hops):
+                f, t = expand_filter_compact(
+                    offsets, dst, ops.frontier_rows(f), cap, (), cap_out,
+                )
+                totals.append(t)
+            return f, jnp.stack(totals)
+
+        return jax.vmap(one)(rows)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), P("data", None)),
+        out_specs=(P("data", None), P("data", None)),
+        check_rep=False,
+    )
+    return jax.jit(fn)
+
+
+def batched_expand_frontiers(
+    mesh: Mesh,
+    offsets: jnp.ndarray,
+    dst: jnp.ndarray,
+    frontiers: np.ndarray,
+    cap: int,
+    n_hops: int = 1,
+):
+    """Run ``n_hops`` fused hops for a [B, R] batch of dense-arena
+    frontiers, the batch axis sharded across the mesh's 'data' axis.
+    Pads B up to the data-axis size and returns (final frontiers
+    int32[B, cap_out], per-hop edge counts int32[B, n_hops]).
+
+    ``cap`` must bound EVERY hop's fan-out for every query (plan it
+    from host degree data, e.g. chain._topm_deg_sum); expand_ascending
+    reports the true edge count but materializes only ``cap`` slots, so
+    an under-planned cap is raised here rather than silently truncating.
+    """
+    nd = mesh.shape["data"]
+    B = len(frontiers)
+    Bp = -(-B // nd) * nd
+    rows = np.full((Bp, frontiers.shape[1]), SENT, dtype=np.int32)
+    rows[:B] = frontiers
+    cap_out = cap
+    step = batched_hop_step(mesh, cap, cap_out, n_hops)
+    f, totals = step(offsets, dst, jnp.asarray(rows))
+    totals = np.asarray(totals[:B])
+    if totals.size and int(totals.max()) > cap:
+        raise ValueError(
+            f"hop fan-out {int(totals.max())} exceeds cap {cap}: "
+            "re-plan cap from the worst-hop degree bound"
+        )
+    return np.asarray(f[:B]), totals
+
+
 def sharded_two_hop(mesh: Mesh, arena: ShardedArena, frontier: np.ndarray, cap1: int, cap2: int):
     """Two-hop sharded traversal: returns (hop1 uids, hop2 uids) padded."""
     step1 = sharded_expand_step(mesh, cap1)

@@ -410,6 +410,26 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
             order_statics.append(None)
             orders.append(None)
 
+    # --- lax.scan multi-hop fast path (ops/batch.py) ---
+    # Light, same-arena, undecorated chains (the `v as x { friend {
+    # friend } }` reachability shape) ride the donated-carry scan
+    # driver: the frontier never leaves the device between hops and the
+    # per-level packed-output staging disappears entirely.  Decorated or
+    # mixed-arena chains keep the staged program below.
+    undecorated = all(k is None for k in keeps) and all(
+        o is None for o in order_statics
+    )
+    if (
+        light
+        and undecorated
+        and all(a is arenas[0] for a in arenas)
+        # honor the fused-executor kill switch (DGRAPH_TPU_FUSED_HOP=0):
+        # the scan driver is part of ops/batch.py's fused machinery
+        and getattr(engine.expander, "fused_hop", "0") != "0"
+        and _try_chain_scan(engine, levels, arenas[0], src, est_edges, universe)
+    ):
+        return True
+
     caps: List[Tuple[int, int, int, bool, bool, Optional[tuple]]] = []
     B = ops.bucket(max(1, len(src)))  # row-vector length entering level i
     m = len(src)  # bound on the unique frontier entering each level
@@ -541,6 +561,52 @@ def _resolve_filter_global(engine, ft, resolver) -> np.ndarray:
     from dgraph_tpu.query.functions import QueryError
 
     raise QueryError("not-filter is not chain-fusable")
+
+
+def _topm_deg_sum(arena, m: int) -> int:
+    """Upper bound on the RAW degree sum of ANY m distinct rows (cumsum
+    of descending-sorted degrees, cached) — the expand_ascending
+    counterpart of _topm_ov_chunk_sum."""
+    cs = getattr(arena, "_topm_deg", None)
+    if cs is None:
+        deg = np.sort(arena.h_offsets[1:] - arena.h_offsets[:-1])[::-1]
+        cs = np.concatenate([[0], np.cumsum(deg)])
+        arena._topm_deg = cs
+    return int(cs[min(m, len(cs) - 1)])
+
+
+def _try_chain_scan(engine, levels, arena, src, est_edges, universe) -> bool:
+    """Run a light same-arena undecorated chain through the lax.scan
+    multi-hop driver (ops.multi_hop): one scan program, frontier
+    device-resident, carry donated.  Returns False when the uniform
+    carry capacity (scan requires one shape for every hop) would blow
+    the light memory budget — the staged per-level program then runs."""
+    caps = [est_edges]
+    m = min(est_edges, max(1, arena.n_distinct_dst()))
+    for _ in levels[1:]:
+        e = _topm_deg_sum(arena, m)
+        caps.append(e)
+        m = min(e, max(1, arena.n_distinct_dst()))
+    cap = ops.bucket(max(max(caps), len(src), 1))
+    if cap > CHAIN_MAX_CAPC_LIGHT * ops.CHUNK:
+        return False
+    arena.ensure_device()
+    lut = arena.lut(universe)
+    f = jnp.asarray(ops.pad_to(np.asarray(src, dtype=np.int64), cap))
+    vis = jnp.full((cap,), SENT, dtype=jnp.int32)
+    fs, totals, _vis = ops.multi_hop(
+        arena.offsets, arena.dst, f, vis, len(levels), cap, lut=lut
+    )
+    fs = np.asarray(fs)
+    totals = np.asarray(totals)
+    src_list = np.asarray(src, dtype=np.int64)
+    for i, sg in enumerate(levels):
+        sg.chain_filtered = False
+        sg.chain_ordered = False
+        dest = fs[i][fs[i] != SENT].astype(np.int64)
+        sg.chain_stash = ("light", dest, src_list, int(totals[i]))
+        src_list = dest
+    return True
 
 
 def _topm_ov_chunk_sum(arena, m: int) -> int:

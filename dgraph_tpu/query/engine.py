@@ -90,6 +90,127 @@ def _fresh_stats() -> dict:
     }
 
 
+class DeviceExpander:
+    """Per-level expansion routing: ONE device program (or one host
+    numpy pass) per (level × predicate), whatever the backend.
+
+    Routing order per call: mesh-sharded (big multi-device predicates) →
+    host numpy (below expand_device_min, transport-bound) → fused
+    classed-gather hop (ops/batch.py — scatter/sort-free, the win on
+    backends where XLA scatter+sort lag its gathers; requires an
+    ascending-distinct frontier) → inline-head device path (the TPU
+    gather-rate layout) → order-agnostic packed CSR (any frontier
+    order).  The fused path is gated by ``fused_hop``:
+
+      "0"    — never (legacy per-op routing only)
+      "1"/"" — auto: on where the default backend is cpu (measured: XLA
+               CPU scatter ≈ 100ns/update and sort ≈ 10× numpy, so the
+               gather-only classed program wins), off on tpu where the
+               inline-head layout is tuned to the gather engine
+      "force" — always (tests force cross-backend coverage with this)
+
+    Env: DGRAPH_TPU_FUSED_HOP.
+    """
+
+    def __init__(self, engine: "QueryEngine"):
+        self.engine = engine
+        self.fused_hop = os.environ.get("DGRAPH_TPU_FUSED_HOP", "1")
+
+    def _use_classed(self) -> bool:
+        if self.fused_hop == "0":
+            return False
+        if self.fused_hop == "force":
+            return True
+        import jax
+
+        return jax.default_backend() == "cpu"
+
+    def expand(
+        self, arena, src: np.ndarray, attr: str = "", reverse: bool = False
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One batched device gather for a whole level (the TPU replacement
+        for the reference's per-key loop, worker/task.go:287-440).  Big
+        predicates on a multi-device mesh expand sharded: each device owns
+        a uid range of rows, results merge via all_gather (SURVEY §2b —
+        intra-predicate sharding the reference lacks)."""
+        eng = self.engine
+        n = len(src)
+        if n == 0 or arena.n_edges == 0:
+            return _EMPTY, np.zeros(n + 1, dtype=np.int64)
+        rows = arena.rows_for_uids_host(src)
+        total = int(arena.degree_of_rows(rows).sum())
+        if total == 0:
+            return _EMPTY, np.zeros(n + 1, dtype=np.int64)
+        cap = ops.bucket(total)
+        if attr and eng.arenas.use_mesh_for(arena):
+            from dgraph_tpu.parallel.mesh import sharded_expand_segments
+
+            sharded = eng.arenas.sharded_csr(attr, reverse=reverse)
+            t0 = _time.perf_counter()
+            out, seg_ptr = sharded_expand_segments(
+                eng.arenas.mesh, sharded, src, cap
+            )
+            eng.stats["edges"] += len(out)
+            eng.stats["device_expand_ms"] += (_time.perf_counter() - t0) * 1e3
+            return out, seg_ptr
+        if total < eng.expand_device_min:
+            # small expansion: vectorized numpy over the host CSR mirror —
+            # a device dispatch costs a transport round trip that dwarfs
+            # the work (the size-adaptive routing the reference does
+            # per-intersection, algo/uidlist.go:56-64, done per-level)
+            t0 = _time.perf_counter()
+            out, seg_ptr = arena.expand_host(rows)
+            eng.stats["edges"] += len(out)
+            eng.stats["host_expand_ms"] += (_time.perf_counter() - t0) * 1e3
+            return out, seg_ptr
+        # big single-device expansion.  The inline-head fast path (one
+        # 32B row gather serves metadata + the first INLINE targets;
+        # docs/ROOFLINE.md round 4) and the classed-gather path both
+        # require ASCENDING-distinct rows — an ordered root permutes the
+        # frontier, so those fall back to the order-agnostic CSR gather.
+        valid_rows = rows[rows >= 0]
+        ascending = bool(np.all(valid_rows[1:] > valid_rows[:-1]))
+        t0 = _time.perf_counter()
+        if ascending and self._use_classed():
+            arena.ensure_device()  # re-upload after incremental deltas
+            ce = ops.classed_for_arena(arena)
+            out, seg_ptr = ce.expand_rows(rows, arena.degree_of_rows(rows))
+            eng.stats["device_expand_ms"] += (_time.perf_counter() - t0) * 1e3
+            eng.stats["edges"] += len(out)
+            return out, seg_ptr
+        if ascending:
+            metap, ov_chunks = arena.inline_layout()
+            B = ops.bucket(n)
+            capov = ops.bucket(
+                max(1, int(arena.ov_chunk_degree_of_rows(rows).sum()))
+            )
+            packed = np.asarray(  # one fetch: inline|ov|ovseg concatenated
+                _packed_expand_inline(
+                    metap, ov_chunks, ops.pad_rows(rows, B), capov
+                )
+            )
+            eng.stats["device_expand_ms"] += (_time.perf_counter() - t0) * 1e3
+            from dgraph_tpu.query.chain import packed_inline_to_matrix
+
+            out, seg_ptr = packed_inline_to_matrix(packed, B, capov, n)
+            eng.stats["edges"] += len(out)
+            return out, seg_ptr
+        arena.ensure_device()  # re-upload after incremental host deltas
+        packed = np.asarray(  # one fetch: out|seg concatenated on device
+            _packed_expand_csr(
+                arena.offsets, arena.dst, ops.pad_rows(rows, ops.bucket(n)), cap
+            )
+        )
+        eng.stats["device_expand_ms"] += (_time.perf_counter() - t0) * 1e3
+        out = packed[:total].astype(np.int64)
+        seg = packed[cap : cap + total].astype(np.int64)
+        counts = np.bincount(seg, minlength=n)
+        seg_ptr = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(counts, out=seg_ptr[1:])
+        eng.stats["edges"] += len(out)
+        return out, seg_ptr
+
+
 class QueryEngine:
     """One engine instance per store; thread-unsafe by design (the serving
     layer serializes, as the reference does per-request goroutines over
@@ -124,6 +245,9 @@ class QueryEngine:
         # minimum estimated fan-out before chains fuse into one device
         # program (below it, per-level host orchestration wins on latency)
         self.chain_threshold = CHAIN_THRESHOLD
+        # per-level expansion routing, incl. the fused batched hop path
+        # (ops/batch.py) — see DeviceExpander
+        self.expander = DeviceExpander(self)
         # below this fan-out an expansion runs as vectorized numpy on the
         # host CSR mirror: a device dispatch pays a transport round trip
         # (~130ms through the axon tunnel, ~100µs co-located) that only
@@ -295,10 +419,13 @@ class QueryEngine:
             if child.counts is not None:
                 continue  # counts exist for every src uid
             if child.values:
-                has = np.fromiter(
-                    (int(u) in child.values for u in dest.tolist()),
-                    dtype=bool, count=len(dest),
+                # one vectorized membership probe per child instead of a
+                # dict-lookup per (dest uid × child) — @cascade on a wide
+                # result was O(U×V) python
+                vk = np.fromiter(
+                    child.values.keys(), dtype=np.int64, count=len(child.values)
                 )
+                has = np.isin(dest, vk)
             elif len(child.seg_ptr) > 1:
                 # child expanded with dest as its src: row-degree > 0
                 degs = np.diff(child.seg_ptr)
@@ -545,79 +672,9 @@ class QueryEngine:
     def _expand(
         self, arena, src: np.ndarray, attr: str = "", reverse: bool = False
     ) -> Tuple[np.ndarray, np.ndarray]:
-        """One batched device gather for a whole level (the TPU replacement
-        for the reference's per-key loop, worker/task.go:287-440).  Big
-        predicates on a multi-device mesh expand sharded: each device owns
-        a uid range of rows, results merge via all_gather (SURVEY §2b —
-        intra-predicate sharding the reference lacks)."""
-        n = len(src)
-        if n == 0 or arena.n_edges == 0:
-            return _EMPTY, np.zeros(n + 1, dtype=np.int64)
-        rows = arena.rows_for_uids_host(src)
-        total = int(arena.degree_of_rows(rows).sum())
-        if total == 0:
-            return _EMPTY, np.zeros(n + 1, dtype=np.int64)
-        cap = ops.bucket(total)
-        if attr and self.arenas.use_mesh_for(arena):
-            from dgraph_tpu.parallel.mesh import sharded_expand_segments
-
-            sharded = self.arenas.sharded_csr(attr, reverse=reverse)
-            t0 = _time.perf_counter()
-            out, seg_ptr = sharded_expand_segments(
-                self.arenas.mesh, sharded, src, cap
-            )
-            self.stats["edges"] += len(out)
-            self.stats["device_expand_ms"] += (_time.perf_counter() - t0) * 1e3
-            return out, seg_ptr
-        if total < self.expand_device_min:
-            # small expansion: vectorized numpy over the host CSR mirror —
-            # a device dispatch costs a transport round trip that dwarfs
-            # the work (the size-adaptive routing the reference does
-            # per-intersection, algo/uidlist.go:56-64, done per-level)
-            t0 = _time.perf_counter()
-            out, seg_ptr = arena.expand_host(rows)
-            self.stats["edges"] += len(out)
-            self.stats["host_expand_ms"] += (_time.perf_counter() - t0) * 1e3
-            return out, seg_ptr
-        # big single-device expansion.  The inline-head fast path (one
-        # 32B row gather serves metadata + the first INLINE targets;
-        # docs/ROOFLINE.md round 4) requires ASCENDING-distinct rows —
-        # an ordered root permutes the frontier, so those fall back to
-        # the order-agnostic CSR gather.
-        valid_rows = rows[rows >= 0]
-        ascending = bool(np.all(valid_rows[1:] > valid_rows[:-1]))
-        t0 = _time.perf_counter()
-        if ascending:
-            metap, ov_chunks = arena.inline_layout()
-            B = ops.bucket(n)
-            capov = ops.bucket(
-                max(1, int(arena.ov_chunk_degree_of_rows(rows).sum()))
-            )
-            packed = np.asarray(  # one fetch: inline|ov|ovseg concatenated
-                _packed_expand_inline(
-                    metap, ov_chunks, ops.pad_rows(rows, B), capov
-                )
-            )
-            self.stats["device_expand_ms"] += (_time.perf_counter() - t0) * 1e3
-            from dgraph_tpu.query.chain import packed_inline_to_matrix
-
-            out, seg_ptr = packed_inline_to_matrix(packed, B, capov, n)
-            self.stats["edges"] += len(out)
-            return out, seg_ptr
-        arena.ensure_device()  # re-upload after incremental host deltas
-        packed = np.asarray(  # one fetch: out|seg concatenated on device
-            _packed_expand_csr(
-                arena.offsets, arena.dst, ops.pad_rows(rows, ops.bucket(n)), cap
-            )
-        )
-        self.stats["device_expand_ms"] += (_time.perf_counter() - t0) * 1e3
-        out = packed[:total].astype(np.int64)
-        seg = packed[cap : cap + total].astype(np.int64)
-        counts = np.bincount(seg, minlength=n)
-        seg_ptr = np.zeros(n + 1, dtype=np.int64)
-        np.cumsum(counts, out=seg_ptr[1:])
-        self.stats["edges"] += len(out)
-        return out, seg_ptr
+        """One batched device gather for a whole level — routing lives on
+        the DeviceExpander (see class docstring)."""
+        return self.expander.expand(arena, src, attr=attr, reverse=reverse)
 
     # -- filters -----------------------------------------------------------
 
