@@ -25,11 +25,17 @@ verified against numpy), so the edges/s number cannot be faked by XLA
 dead-code elimination.
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline",
-"fused_hop", "hop_dedup", ...}.
+"fused_hop", "hop_dedup", "serving", ...}.  "serving" is the closed-loop
+multi-client A/B (run_serving_bench): the same 2-hop workload through
+the cohort scheduler (DGRAPH_TPU_SCHED=1) and the serial per-request
+path (=0), with QPS, p50/p99 latency, mean cohort occupancy,
+flush-reason counts and a response-parity check.
 Environment knobs: BENCH_NODES, BENCH_EDGES, BENCH_SEEDS, BENCH_ITERS,
 BENCH_SCALE (shrink everything by a factor: 0.1 -> 200k nodes / 2.1M
 edges), BENCH_DEDUP (host|device|auto), BENCH_PROBE_BUDGET /
-BENCH_PROBE_TIMEOUT / BENCH_INIT_RETRIES (backend probe knobs).
+BENCH_PROBE_TIMEOUT / BENCH_INIT_RETRIES (backend probe knobs),
+BENCH_SERVE (0 skips the serving A/B) / BENCH_CLIENTS /
+BENCH_SERVE_SECONDS / BENCH_SERVE_NODES / BENCH_SERVE_DEG.
 
 Robustness contract (round-1 postmortem: the round artifact was empty
 because a wedged TPU turned into an unhandled stack dump): the TPU
@@ -395,6 +401,197 @@ def _run_device_dedup(a, frontiers, fcap):
     return best, edges, np.asarray(chks), last_set
 
 
+def _serving_store(n_nodes: int, deg: int, seed: int = 13):
+    """Small serving graph: one uid predicate 'e' with ~deg out-edges per
+    node + a name value per node (gives filters something to chew)."""
+    from dgraph_tpu.models import PostingStore
+
+    rng = np.random.default_rng(seed)
+    store = PostingStore()
+    store.apply_schema("e: uid @count .\nname: string .")
+    src = np.repeat(np.arange(1, n_nodes + 1, dtype=np.int64), deg)
+    dst = rng.integers(1, n_nodes + 1, size=len(src)).astype(np.int64)
+    store.bulk_set_uid_edges("e", src, dst)
+    return store
+
+
+def _serving_mode(sched_on: bool, store, variants, clients: int, secs: float):
+    """One closed-loop run: ``clients`` threads fire queries for ``secs``
+    against a fresh DgraphServer (scheduler gated by ``sched_on``).
+    Returns (qps, p50_ms, p99_ms, {query: response}, completed)."""
+    import json as _json
+    import threading
+
+    os.environ["DGRAPH_TPU_SCHED"] = "1" if sched_on else "0"
+    from dgraph_tpu.serve.server import DgraphServer
+
+    srv = DgraphServer(store)
+    srv.start()
+    try:
+        import http.client
+
+        def mkconn():
+            return http.client.HTTPConnection(
+                "127.0.0.1", srv.port, timeout=30
+            )
+
+        def post_on(conn, q):
+            # persistent connection (the server speaks HTTP/1.1
+            # keep-alive): no TCP handshake per query
+            conn.request("POST", "/query", body=q.encode())
+            r = conn.getresponse()
+            body = r.read()
+            if r.status != 200:
+                raise RuntimeError(f"HTTP {r.status}: {body[:200]!r}")
+            return _json.loads(body.decode())
+
+        warm = mkconn()
+        canon = {}
+        for q in variants:  # warmup + canonical responses (untimed)
+            out = post_on(warm, q)
+            out.pop("server_latency", None)
+            canon[q] = out
+        warm.close()
+
+        lat_lock = threading.Lock()
+        lats: list = []
+        errs: list = []
+        stop_at = [0.0]
+
+        # zipf query popularity (s = BENCH_SERVE_ZIPF, 0 = uniform):
+        # serving traffic has hot queries, and hot queries are what the
+        # scheduler's singleflight coalescing dedups — a uniform draw
+        # would benchmark a traffic shape real services never see
+        s = float(os.environ.get("BENCH_SERVE_ZIPF", 1.1))
+        w = 1.0 / np.power(np.arange(1, len(variants) + 1, dtype=np.float64), s)
+        probs = w / w.sum()
+
+        def client(cid: int):
+            rng = np.random.default_rng(1000 + cid)  # same draw both modes
+            my = []
+            conn = mkconn()
+            try:
+                while time.monotonic() < stop_at[0]:
+                    q = variants[int(rng.choice(len(variants), p=probs))]
+                    t0 = time.monotonic()
+                    out = post_on(conn, q)
+                    my.append(time.monotonic() - t0)
+                    out.pop("server_latency", None)
+                    if out != canon[q]:
+                        raise AssertionError(f"response diverged for {q!r}")
+            except Exception as e:
+                errs.append(e)
+            finally:
+                conn.close()
+            with lat_lock:
+                lats.extend(my)
+
+        ts = [
+            threading.Thread(target=client, args=(c,), daemon=True)
+            for c in range(clients)
+        ]
+        stop_at[0] = time.monotonic() + secs
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=secs + 60)
+        wall = time.monotonic() - t0
+        if errs:
+            raise errs[0]
+        if not lats:
+            raise RuntimeError("serving bench made no requests")
+        a = np.sort(np.asarray(lats))
+        return (
+            len(a) / wall,
+            float(a[int(0.50 * (len(a) - 1))]) * 1e3,
+            float(a[int(0.99 * (len(a) - 1))]) * 1e3,
+            canon,
+            len(a),
+        )
+    finally:
+        srv.stop()
+
+
+def run_serving_bench():
+    """Closed-loop multi-client serving benchmark (ISSUE 2): the same
+    workload through the cohort scheduler (DGRAPH_TPU_SCHED=1) and the
+    serial per-request path (=0), with response-parity checking.
+    Returns the dict merged into the headline JSON under "serving"."""
+    clients = int(os.environ.get("BENCH_CLIENTS", 32))
+    secs = float(os.environ.get("BENCH_SERVE_SECONDS", 4.0))
+    n_nodes = int(os.environ.get("BENCH_SERVE_NODES", 20_000))
+    deg = int(os.environ.get("BENCH_SERVE_DEG", 16))
+    store = _serving_store(n_nodes, deg)
+
+    # 64 same-shape-family 2-hop variants (different seed uids): cohorts
+    # coalesce them, and the count leaf keeps responses JSON-light so the
+    # measurement stays on traversal, not encoding
+    rng = np.random.default_rng(5)
+    variants = []
+    for _ in range(64):
+        seeds = np.unique(rng.integers(1, n_nodes + 1, size=8))
+        ul = ", ".join("0x%x" % u for u in seeds)
+        variants.append("{ q(func: uid(%s)) { e { c: count(e) } } }" % ul)
+
+    from statistics import median
+
+    from dgraph_tpu.utils.metrics import SCHED_COHORT_OCCUPANCY, SCHED_FLUSHES
+
+    reps = max(1, int(os.environ.get("BENCH_SERVE_REPS", 2)))
+    _occ0, occ_sum0, c0 = SCHED_COHORT_OCCUPANCY.snapshot()
+    fl0 = SCHED_FLUSHES.snapshot()
+    # interleave the modes: the shared host's load swings throughput ~2×
+    # between runs (same caveat as the headline bench), so paired runs +
+    # medians are the only defensible comparison
+    on_runs, off_runs = [], []
+    canon_on = canon_off = None
+    n_on = n_off = 0
+    for _ in range(reps):
+        qps, p50, p99, canon_on, n1 = _serving_mode(
+            True, store, variants, clients, secs
+        )
+        on_runs.append((qps, p50, p99))
+        n_on += n1
+        qps, p50, p99, canon_off, n2 = _serving_mode(
+            False, store, variants, clients, secs
+        )
+        off_runs.append((qps, p50, p99))
+        n_off += n2
+    _occ1, occ_sum1, c1 = SCHED_COHORT_OCCUPANCY.snapshot()
+    fl1 = SCHED_FLUSHES.snapshot()
+    identical = canon_on == canon_off
+    assert identical, "sched on/off responses diverged"
+    flushes = {k: fl1.get(k, 0) - fl0.get(k, 0) for k in fl1}
+    flushes = {k: v for k, v in flushes.items() if v}
+    n_flush = max(c1 - c0, 1)
+    qps_on = median(r[0] for r in on_runs)
+    qps_off = median(r[0] for r in off_runs)
+    return {
+        "clients": clients,
+        "seconds": secs,
+        "reps": reps,
+        "sched_on": {
+            "qps": round(qps_on, 1),
+            "p50_ms": round(median(r[1] for r in on_runs), 2),
+            "p99_ms": round(median(r[2] for r in on_runs), 2),
+            "qps_runs": [round(r[0], 1) for r in on_runs],
+            "requests": n_on,
+        },
+        "sched_off": {
+            "qps": round(qps_off, 1),
+            "p50_ms": round(median(r[1] for r in off_runs), 2),
+            "p99_ms": round(median(r[2] for r in off_runs), 2),
+            "qps_runs": [round(r[0], 1) for r in off_runs],
+            "requests": n_off,
+        },
+        "qps_ratio": round(qps_on / qps_off, 3) if qps_off else None,
+        "cohort_occupancy_mean": round((occ_sum1 - occ_sum0) / n_flush, 2),
+        "flush_reasons": flushes,
+        "responses_identical": identical,
+    }
+
+
 def run_bench(scale: float):
     import jax
 
@@ -457,6 +654,15 @@ def run_bench(scale: float):
 
     dev_eps = dev_edges / dev_s
     cpu_eps = cpu_edges / cpu_s
+
+    serving = None
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        # closed-loop multi-client serving mode (cohort scheduler A/B);
+        # failures here must not void the headline traversal number
+        try:
+            serving = run_serving_bench()
+        except Exception as e:
+            serving = {"error": f"{type(e).__name__}: {e}"}
     print(
         json.dumps(
             {
@@ -464,6 +670,9 @@ def run_bench(scale: float):
                 "value": round(dev_eps, 1),
                 "unit": "edges/s",
                 "vs_baseline": round(dev_eps / cpu_eps, 3),
+                # multi-client serving A/B (BENCH_SERVE=0 skips;
+                # BENCH_CLIENTS / BENCH_SERVE_SECONDS size it)
+                "serving": serving,
                 # self-describing record: a wedged-TPU round falls back to
                 # XLA-on-CPU (see ensure_backend) and must not read as a
                 # TPU measurement

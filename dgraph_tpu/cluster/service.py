@@ -639,6 +639,19 @@ class ClusterStore:
             self._drain_dirty()
             return self._dirty
 
+    @property
+    def version(self) -> int:
+        """Snapshot version for the cohort scheduler's admission
+        signature (PostingStore.version analog): local replica applies
+        bump it.  Remote TTL-cached predicates refresh without a bump,
+        but their staleness window (remote_ttl) dwarfs a cohort's queue
+        time anyway — the signature only needs to split cohorts across
+        LOCAL mutation boundaries."""
+        return sum(
+            getattr(g.store, "version", 0)
+            for g in self._svc.groups.values()
+        )
+
     # -- schema (metadata group) -------------------------------------------
 
     @property

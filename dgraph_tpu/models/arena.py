@@ -65,6 +65,13 @@ class CSRArena:
         r = np.where(ok, rows, 0)
         return np.where(ok, self.h_offsets[r + 1] - self.h_offsets[r], 0)
 
+    @property
+    def avg_degree(self) -> float:
+        """Mean out-degree — the O(1) fan-out estimate the cohort hop
+        merger uses to predict device routing before paying for exact
+        per-row degrees (query/engine.py DeviceExpander.expand)."""
+        return self.n_edges / max(1, self.n_rows)
+
     _h_dst: Optional[np.ndarray] = None
     _n_distinct_dst: Optional[int] = None
 

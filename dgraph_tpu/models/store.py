@@ -149,6 +149,11 @@ class PostingStore:
         self.uids = UidMap()
         self._preds: Dict[str, PredicateData] = {}
         self.dirty: Set[str] = set()
+        # monotonic snapshot version: bumps on every mutation batch so
+        # readers (the cohort scheduler's admission signature,
+        # sched/cohort.py) can tell "same immutable arena snapshot"
+        # apart without hashing store state
+        self.version = 0
         # pred -> [(src, dst, +1|-1), ...] since the last arena refresh;
         # None = overflowed (full rebuild required).  Only uid-edge ops
         # journal here; value mutations always force a full refresh of
@@ -225,6 +230,7 @@ class PostingStore:
         posting/index.go:273 — index derivation happens at arena build)."""
         p = self.pred(e.pred)
         self.dirty.add(e.pred)
+        self.version += 1
         p._wdmirror = None  # any mutation can change uids-with-data
         if e.op == "set":
             if e.value is not None:
@@ -300,6 +306,7 @@ class PostingStore:
             return
         p = self.pred(pred)
         self.dirty.add(pred)
+        self.version += 1
         p._wdmirror = None  # uids-with-data changes under bulk adds too
         self._delta_overflow(pred)  # bulk volume: full rebuild is cheaper
         order = np.argsort(src, kind="stable")
@@ -326,6 +333,7 @@ class PostingStore:
             return
         p = self.pred(pred)
         self.dirty.add(pred)
+        self.version += 1
         p._wdmirror = None
         self._delta_overflow(pred)  # value/index arenas rebuild
         vals = p.values
@@ -350,11 +358,13 @@ class PostingStore:
         from dgraph_tpu.models.schema import parse_schema
 
         parse_schema(text, into=self.schema)
+        self.version += 1
 
     def delete_predicate(self, pred: str) -> None:
         """posting.DeletePredicate analog (posting/index.go:666)."""
         self._preds.pop(pred, None)
         self.dirty.add(pred)
+        self.version += 1
         self._delta_overflow(pred)
 
     def set_edge(self, pred: str, src: int, dst: int, facets=None):

@@ -203,7 +203,13 @@ def sharded_expand_segments(
     """One engine-level expansion over the mesh: returns (out_flat,
     seg_ptr) identical in content to the single-device expand — each
     frontier uid's targets ascending, grouped in frontier order.  All
-    reassembly is device-side; the host only slices the packed buffer."""
+    reassembly is device-side; the host only slices the packed buffer.
+
+    Order-agnostic and deterministic per row, so the cohort scheduler's
+    merged union frontiers (sched/cohort.py::HopMerger) ride this path
+    unchanged: K cross-request sharded dispatches become one, and each
+    member's exact segments slice back out (tests/test_sched.py::
+    test_merged_hops_ride_mesh_path pins the contract)."""
     fcap = _fcap_bucket(len(frontier))
     f = jnp.asarray(ops.pad_to(np.asarray(frontier, dtype=np.int64), fcap))
     step, total_slots = seg_expand_packed_step(mesh, cap, fcap)

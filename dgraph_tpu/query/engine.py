@@ -115,6 +115,11 @@ class DeviceExpander:
     def __init__(self, engine: "QueryEngine"):
         self.engine = engine
         self.fused_hop = os.environ.get("DGRAPH_TPU_FUSED_HOP", "1")
+        # cross-session hop coalescing: the cohort scheduler
+        # (sched/scheduler.py) installs one HopMerger per cohort so
+        # same-(arena, predicate, direction) expansions from different
+        # sessions sharing a snapshot merge into ONE dispatch
+        self.hop_merger = None
 
     def _use_classed(self) -> bool:
         if self.fused_hop == "0":
@@ -126,6 +131,42 @@ class DeviceExpander:
         return jax.default_backend() == "cpu"
 
     def expand(
+        self, arena, src: np.ndarray, attr: str = "", reverse: bool = False
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Per-level expansion entry: routes through the cohort hop
+        merger when one is installed (cross-session dispatch coalescing)
+        AND the expansion is big enough to be device-routed — merging a
+        host-path numpy expansion costs more in union bookkeeping than
+        the per-call overhead it saves, while a device dispatch
+        (~100µs-1ms of fixed cost) amortizes beautifully."""
+        if (
+            self.hop_merger is not None
+            and attr
+            and len(src)
+            and len(src) * arena.avg_degree >= self.engine.expand_device_min
+        ):
+            return self.submit_hop(arena, src, attr, reverse)
+        return self._expand_one(arena, src, attr=attr, reverse=reverse)
+
+    def submit_hop(
+        self, arena, src: np.ndarray, attr: str = "", reverse: bool = False
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Rendezvous this level's expansion with concurrent cohort
+        members: same-(arena, predicate, direction) submissions merge
+        into one union-frontier dispatch, and each session gets its
+        exact per-source segments back (sched/cohort.py::HopMerger —
+        merging is deterministic-per-row, so results are byte-identical
+        to solo expansion)."""
+        key = (attr, bool(reverse), id(arena))
+        return self.hop_merger.submit(
+            key,
+            src,
+            lambda union: self._expand_one(
+                arena, union, attr=attr, reverse=reverse
+            ),
+        )
+
+    def _expand_one(
         self, arena, src: np.ndarray, attr: str = "", reverse: bool = False
     ) -> Tuple[np.ndarray, np.ndarray]:
         """One batched device gather for a whole level (the TPU replacement

@@ -1,0 +1,23 @@
+"""Cohort scheduler: continuous micro-batching of concurrent read
+queries onto the fused device executor (see scheduler.py / cohort.py)."""
+
+from dgraph_tpu.sched.cohort import (
+    Cohort,
+    HopMerger,
+    SchedDeadlineError,
+    SchedOverloadError,
+    SchedRequest,
+    hop_signature,
+)
+from dgraph_tpu.sched.scheduler import CohortScheduler, sched_enabled
+
+__all__ = [
+    "Cohort",
+    "CohortScheduler",
+    "HopMerger",
+    "SchedDeadlineError",
+    "SchedOverloadError",
+    "SchedRequest",
+    "hop_signature",
+    "sched_enabled",
+]

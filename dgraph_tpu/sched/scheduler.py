@@ -1,0 +1,433 @@
+"""Continuous micro-batching of concurrent read queries onto the engine.
+
+`CohortScheduler` sits between the serving surfaces (serve/server.py,
+serve/grpc_server.py) and the query engine.  Eligible requests — pure
+reads; mutations keep their exclusive write-lock path untouched — are
+admitted into shape-bucketed cohorts (sched/cohort.py) instead of
+grabbing the read lock one by one, the way continuous batching fills
+the batch axis in modern inference serving.
+
+A cohort flushes on the first of three triggers (each flush records its
+reason in `dgraph_sched_flushes_total{reason=...}`):
+
+- **full** — the cohort reached ``max_batch`` members;
+- **deadline** — its oldest member has waited ``flush_ms``;
+- **idle** — no new request arrived for an idle beat, so waiting longer
+  cannot grow any cohort (a lone client must not eat the full flush
+  deadline per query).
+
+Flushes execute on a BOUNDED worker pool (``DGRAPH_TPU_SCHED_CONCURRENCY``,
+default 2) — the property that makes the batching *continuous*: while
+the workers chew the current cohorts, new arrivals accumulate into the
+next ones instead of each grabbing its own handler thread, so under
+load the batch axis fills itself and the thundering-herd GIL convoy of
+N compute threads collapses to a few.
+
+A flush takes the engine read lock ONCE for the whole cohort, runs
+each member on its own engine shell over the shared arena cache, and
+hands every shell one `HopMerger` — same-shape hops from different
+sessions coalesce into one device dispatch (`DeviceExpander.submit_hop`).
+IDENTICAL requests (same text/vars/debug) go further and singleflight:
+one execution serves every twin, whether queued in the same cohort or
+already executing over the same store snapshot — under zipf traffic the
+hot queries are exactly where the duplicates are.
+
+Admission control: a bounded queue (``queue_cap``); requests over
+capacity shed immediately (`SchedOverloadError` → HTTP 429 / gRPC
+RESOURCE_EXHAUSTED), and requests whose deadline lapses while queued
+shed with `SchedDeadlineError` (→ HTTP 504 / gRPC DEADLINE_EXCEEDED)
+instead of rotting in a cohort queue.
+
+Knobs (env): ``DGRAPH_TPU_SCHED`` (gate, default on; ``0`` restores the
+serial per-request path byte-identically), ``DGRAPH_TPU_SCHED_MAX_BATCH``
+(default 32), ``DGRAPH_TPU_SCHED_FLUSH_MS`` (default 2.0),
+``DGRAPH_TPU_SCHED_QUEUE_CAP`` (default 256),
+``DGRAPH_TPU_SCHED_MERGE_MS`` (hop-merge window, default 1.0),
+``DGRAPH_TPU_SCHED_CONCURRENCY`` (flush workers, default 2).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dgraph_tpu.sched.cohort import (
+    Cohort,
+    HopMerger,
+    SchedDeadlineError,
+    SchedOverloadError,
+    SchedRequest,
+    hop_signature,
+)
+from dgraph_tpu.utils.metrics import (
+    SCHED_COALESCED,
+    SCHED_COHORT_OCCUPANCY,
+    SCHED_FLUSHES,
+    SCHED_QUEUE_DEPTH,
+    SCHED_QUEUE_WAIT,
+    SCHED_SHED,
+)
+
+
+def sched_enabled() -> bool:
+    """The DGRAPH_TPU_SCHED gate (default ON)."""
+    return os.environ.get("DGRAPH_TPU_SCHED", "1") != "0"
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class CohortScheduler:
+    """Owns the admission queues and the flush loop for one server."""
+
+    def __init__(
+        self,
+        server,
+        max_batch: Optional[int] = None,
+        flush_ms: Optional[float] = None,
+        queue_cap: Optional[int] = None,
+        merge_ms: Optional[float] = None,
+        concurrency: Optional[int] = None,
+    ):
+        self._server = server
+        self.max_batch = int(
+            max_batch
+            if max_batch is not None
+            else _env_f("DGRAPH_TPU_SCHED_MAX_BATCH", 32)
+        )
+        self.flush_s = (
+            flush_ms if flush_ms is not None
+            else _env_f("DGRAPH_TPU_SCHED_FLUSH_MS", 2.0)
+        ) / 1e3
+        self.queue_cap = int(
+            queue_cap
+            if queue_cap is not None
+            else _env_f("DGRAPH_TPU_SCHED_QUEUE_CAP", 256)
+        )
+        self.merge_window_s = (
+            merge_ms if merge_ms is not None
+            else _env_f("DGRAPH_TPU_SCHED_MERGE_MS", 1.0)
+        ) / 1e3
+        # idle trigger beat: how long "no arrivals" must last before
+        # pending cohorts flush early; a fraction of the flush deadline
+        self.idle_beat_s = max(self.flush_s / 8.0, 1e-4)
+        self._cond = threading.Condition()
+        self._queues: Dict[tuple, Cohort] = {}
+        self._depth = 0
+        self._last_arrival = 0.0  # monotonic time of the newest admit
+        self._stopped = False
+        self._flushes = 0   # total cohort flushes (tests/bench introspection)
+        # singleflight across EXECUTION, not just the queue window:
+        # key -> [store_version, leader SchedRequest, [attached reqs]].
+        # An identical request arriving while its twin executes attaches
+        # and shares the result — the dedup window becomes the whole
+        # service time, which under zipf traffic is where the duplicates
+        # actually are.
+        self._inflight: Dict[object, list] = {}
+        n_workers = int(
+            concurrency
+            if concurrency is not None
+            else _env_f("DGRAPH_TPU_SCHED_CONCURRENCY", 2)
+        )
+        self._workers = [
+            threading.Thread(
+                target=self._worker_loop, name=f"dgraph-sched-{i}",
+                daemon=True,
+            )
+            for i in range(max(1, n_workers))
+        ]
+        for t in self._workers:
+            t.start()
+
+    # -- admission ---------------------------------------------------------
+
+    def run(
+        self,
+        parsed,
+        debug: bool = False,
+        timeout_s: Optional[float] = None,
+        key=None,
+    ):
+        """Admit a read-only parsed request and block until its cohort
+        executed.  ``key`` (query text + canonical vars + debug) enables
+        singleflight: equal-key cohort members execute once.  Returns
+        (response dict, engine stats); raises SchedOverloadError /
+        SchedDeadlineError on shed."""
+        # timeout_s None = no budget; <= 0 = budget ALREADY spent (a
+        # gRPC deadline that lapsed in transit, X-Dgraph-Timeout: 0) —
+        # that sheds immediately rather than silently running unbounded
+        deadline = (
+            time.monotonic() + max(timeout_s, 0.0)
+            if timeout_s is not None
+            else None
+        )
+        req = SchedRequest(parsed, debug=debug, deadline=deadline, key=key)
+        # duck-typed stores (ClusterStore) may predate .version; 0 keeps
+        # them schedulable, merely coalescing across mutation boundaries
+        # their own read path already treats as eventually consistent
+        sig = hop_signature(
+            parsed, getattr(self._server.store, "version", 0)
+        )
+        with self._cond:
+            if self._stopped:
+                raise SchedOverloadError("scheduler stopped")
+            if self._depth >= self.queue_cap:
+                SCHED_SHED.add("overload")
+                raise SchedOverloadError(
+                    f"admission queue over capacity ({self.queue_cap})"
+                )
+            ent = self._inflight.get(key) if key is not None else None
+            if ent is not None and ent[0] == sig[0]:
+                # an identical request is executing over the same
+                # snapshot right now: attach and share its result
+                ent[2].append(req)
+                self._depth += 1
+                SCHED_QUEUE_DEPTH.set(self._depth)
+                SCHED_COALESCED.add(1)
+            else:
+                c = self._queues.get(sig)
+                if c is None:
+                    c = self._queues[sig] = Cohort(sig)
+                c.reqs.append(req)
+                self._depth += 1
+                self._last_arrival = time.monotonic()
+                SCHED_QUEUE_DEPTH.set(self._depth)
+                self._cond.notify_all()
+        return req.wait()
+
+    # -- flush workers -----------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            cohort, reason = self._next_cohort()
+            if cohort is None:
+                return
+            self._flush(cohort, reason)
+
+    def _next_cohort(self):
+        """Block until some cohort is due, pop and return it.  Priority:
+        full > deadline-expired > idle (oldest first).  While every
+        worker is busy flushing, pending cohorts keep accumulating
+        members — that accumulation IS the continuous batching."""
+        with self._cond:
+            while True:
+                if self._stopped:
+                    return None, None
+                now = time.monotonic()
+                due = None
+                for sig, c in self._queues.items():
+                    if len(c.reqs) >= self.max_batch:
+                        due = (sig, "full")
+                        break
+                if due is None:
+                    for sig, c in self._queues.items():
+                        if now - c.born >= self.flush_s:
+                            due = (sig, "deadline")
+                            break
+                if (
+                    due is None
+                    and self._queues
+                    and now - self._last_arrival >= self.idle_beat_s
+                ):
+                    sig = min(
+                        self._queues, key=lambda s: self._queues[s].born
+                    )
+                    due = (sig, "idle")
+                if due is not None:
+                    sig, reason = due
+                    return self._queues.pop(sig), reason
+                if not self._queues:
+                    self._cond.wait()
+                else:
+                    oldest = min(c.born for c in self._queues.values())
+                    self._cond.wait(max(
+                        min(
+                            oldest + self.flush_s - now,
+                            self._last_arrival + self.idle_beat_s - now,
+                        ),
+                        1e-4,
+                    ))
+
+    # -- execution ---------------------------------------------------------
+
+    def _flush(self, cohort: Cohort, reason: str) -> None:
+        SCHED_FLUSHES.add(reason)
+        SCHED_COHORT_OCCUPANCY.observe(len(cohort.reqs))
+        now = time.monotonic()
+        live: List[SchedRequest] = []
+        for req in cohort.reqs:
+            SCHED_QUEUE_WAIT.observe(now - req.enqueued)
+            if req.expired(now):
+                self._shed_deadline(req, now)
+            else:
+                live.append(req)
+        with self._cond:
+            # depth bounds IN-FLIGHT requests (admitted − completed):
+            # only the already-shed ones leave here, the rest leave as
+            # they complete — so a blocked engine (writer holding the
+            # lock) backs admission up into 429s instead of unbounded
+            # thread/memory growth
+            self._depth -= len(cohort.reqs) - len(live)
+            SCHED_QUEUE_DEPTH.set(self._depth)
+            self._flushes += 1
+        if not live:
+            return
+        # singleflight: equal-key members are the same deterministic
+        # computation — run the first of each key, deal its result to
+        # the duplicates (zipf traffic makes this the big win: a hot
+        # query arriving K× inside one flush window costs one execution)
+        leaders: List[SchedRequest] = []
+        dups: Dict[object, List[SchedRequest]] = {}
+        seen: Dict[object, SchedRequest] = {}
+        for req in live:
+            k = req.key
+            if k is not None and k in seen:
+                dups.setdefault(k, []).append(req)
+            else:
+                if k is not None:
+                    seen[k] = req
+                leaders.append(req)
+        n_dup = len(live) - len(leaders)
+        if n_dup:
+            SCHED_COALESCED.add(n_dup)
+        # publish keyed leaders so identical arrivals during execution
+        # attach instead of re-running (skip keys another flush already
+        # owns — its version differs, or it registered first)
+        registered: List[SchedRequest] = []
+        with self._cond:
+            for req in leaders:
+                if req.key is not None and req.key not in self._inflight:
+                    self._inflight[req.key] = [cohort.sig[0], req, []]
+                    registered.append(req)
+        merger = HopMerger(len(leaders), window_s=self.merge_window_s)
+        srv = self._server
+        try:
+            with srv._engine_lock.read():  # ONE read acquisition per cohort
+                if len(leaders) == 1:
+                    self._run_one(leaders[0], merger)
+                else:
+                    # fresh threads per flush, not a persistent pool:
+                    # spawn cost (~100µs each) is noise next to cohort
+                    # service time, occupancy keeps the count small, and
+                    # a shared pool would need anti-starvation sizing
+                    # across concurrent flushes
+                    threads = [
+                        threading.Thread(
+                            target=self._run_one, args=(req, merger),
+                            name="dgraph-cohort", daemon=True,
+                        )
+                        for req in leaders[1:]
+                    ]
+                    for t in threads:
+                        t.start()
+                    self._run_one(leaders[0], merger)
+                    for t in threads:
+                        t.join()
+                for k, followers in dups.items():
+                    lead = seen[k]
+                    for req in followers:
+                        if req.result is not None or req.error is not None:
+                            continue
+                        if lead.error is None:
+                            # results are read-only from here on
+                            # (handlers only encode them): sharing is safe
+                            req.complete(lead.result, lead.stats)
+                        elif isinstance(lead.error, SchedDeadlineError):
+                            # the leader ran out of budget but this
+                            # duplicate still has some: run it (rare)
+                            self._run_one(req, merger)
+                        else:
+                            req.fail(lead.error)
+        except BaseException as e:  # lock failure etc.: fail, never hang
+            for req in live:
+                if req.result is None and req.error is None:
+                    req.fail(e)
+        finally:
+            attached: List = []
+            with self._cond:
+                for req in registered:
+                    ent = self._inflight.pop(req.key, None)
+                    if ent is not None:
+                        attached.append((req, ent[2]))
+            n_att = 0
+            for lead, followers in attached:
+                n_att += len(followers)
+                for req in followers:
+                    self._complete_follower(req, lead, merger)
+            with self._cond:
+                self._depth -= len(live) + n_att
+                SCHED_QUEUE_DEPTH.set(self._depth)
+
+    def _complete_follower(self, req, lead, merger) -> None:
+        """Deal a singleflight leader's outcome to an attached twin."""
+        if req.result is not None or req.error is not None:
+            return
+        if lead.error is None:
+            req.complete(lead.result, lead.stats)
+        elif isinstance(lead.error, SchedDeadlineError) and not req.expired():
+            # leader ran out of budget but this twin still has some: run
+            # it for real (rare — needs its own read hold)
+            with self._server._engine_lock.read():
+                self._run_one(req, merger)
+        else:
+            req.fail(lead.error)
+
+    def _shed_deadline(self, req: SchedRequest, now: float) -> None:
+        SCHED_SHED.add("deadline")
+        req.fail(SchedDeadlineError(
+            "deadline expired while queued "
+            f"({(now - req.enqueued) * 1e3:.1f}ms in cohort)"
+        ))
+
+    def _run_one(self, req: SchedRequest, merger: HopMerger) -> None:
+        from dgraph_tpu.query import outputnode
+        from dgraph_tpu.query.engine import QueryEngine
+
+        srv = self._server
+        try:
+            if req.expired():
+                # budget lapsed while the cohort waited on the engine
+                # lock (a long write was in front of us): shed, don't run
+                self._shed_deadline(req, time.monotonic())
+                return
+            eng = QueryEngine(srv.store, arenas=srv.engine.arenas)
+            eng.chain_threshold = srv.engine.chain_threshold
+            eng.expander.hop_merger = merger
+            eng.dump_shapes = bool(srv.dumpsg_path)
+            token = outputnode.DEBUG_UIDS.set(req.debug)
+            try:
+                out = eng.run_parsed(req.parsed)
+            finally:
+                outputnode.DEBUG_UIDS.reset(token)
+            if srv.dumpsg_path and eng.last_dump:
+                srv._dump_subgraphs(eng.last_dump)
+            req.complete(out, dict(eng.stats))
+        except BaseException as e:
+            req.fail(e)
+        finally:
+            merger.leave()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self) -> None:
+        """Stop admitting and fail whatever is still queued (callers get
+        a retriable error; the server is tearing down anyway)."""
+        with self._cond:
+            if self._stopped:
+                return
+            self._stopped = True
+            pending = [r for c in self._queues.values() for r in c.reqs]
+            self._queues.clear()
+            self._depth = 0
+            SCHED_QUEUE_DEPTH.set(0)
+            self._cond.notify_all()
+        for req in pending:
+            req.fail(SchedOverloadError("server shutting down"))
+        for t in self._workers:
+            t.join(timeout=5)

@@ -452,9 +452,25 @@ class GrpcServer:
                           f"bad Request message: {e}")
         if not text.strip():
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty request")
+        # propagate the client's gRPC deadline into the cohort
+        # scheduler's per-request budget: a request that cannot make its
+        # deadline sheds (DEADLINE_EXCEEDED) instead of queueing forever
         try:
-            out = self._server.run_query(text, vars_ or None)
+            timeout_s = context.time_remaining()
+        except Exception:  # transport without deadline support
+            timeout_s = None
+        if timeout_s is not None and timeout_s > 1e8:
+            timeout_s = None  # "no deadline" sentinel from grpcio
+        try:
+            out = self._server.run_query(text, vars_ or None,
+                                         timeout_s=timeout_s)
         except Exception as e:
+            from dgraph_tpu.sched import SchedDeadlineError, SchedOverloadError
+
+            if isinstance(e, SchedOverloadError):
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            if isinstance(e, SchedDeadlineError):
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
             code = (
                 grpc.StatusCode.INVALID_ARGUMENT
                 if type(e).__name__ in ("GqlError", "QueryError", "ValueError")

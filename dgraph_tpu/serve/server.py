@@ -29,7 +29,13 @@ from dgraph_tpu.utils.rwlock import RWLock
 from dgraph_tpu.utils.metrics import (
     NUM_QUERIES,
     PENDING_QUERIES,
+    QUERY_LATENCY,
     metrics,
+)
+from dgraph_tpu.sched import (
+    SchedDeadlineError,
+    SchedOverloadError,
+    sched_enabled,
 )
 from dgraph_tpu.utils.trace import Tracer
 
@@ -37,7 +43,12 @@ _CORS = {
     "Access-Control-Allow-Origin": "*",
     "Access-Control-Allow-Methods": "POST, GET, OPTIONS",
     "Access-Control-Allow-Headers": "Content-Type",
-    "Connection": "close",
+    # NOTE: no forced "Connection: close" — every _reply carries an
+    # exact Content-Length, so HTTP/1.1 keep-alive is sound and a
+    # high-QPS client fleet stops paying a TCP handshake per query.
+    # Clients that send "Connection: close" (urllib does) still get
+    # per-request connections; idle keep-alive sockets fall to the
+    # handler's 60s read timeout.
 }
 
 
@@ -100,12 +111,32 @@ class DgraphServer:
         # the CLI passes --cpu (profiling must cover handler threads,
         # where all query execution happens — not just the main thread)
         self._profiler = profiler
+        # cohort scheduler (sched/): coalesces concurrent read queries
+        # into shape-bucketed cohorts riding the fused executor.  Gated
+        # by DGRAPH_TPU_SCHED (default on); =0 restores the serial
+        # per-request path byte-identically.  Profiled runs stay serial
+        # (cProfile is not thread-safe), so no scheduler there either.
+        self.scheduler = None
+        if sched_enabled() and profiler is None:
+            from dgraph_tpu.sched import CohortScheduler
+
+            self.scheduler = CohortScheduler(self)
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
         handler = _make_handler(self)
-        self._httpd = ThreadingHTTPServer((self._bind, self._port), handler)
+
+        # deep accept backlog: the stdlib default (5) drops SYNs the
+        # moment a few dozen clients connect at once (keep-alive helps,
+        # but urllib-style clients still open a connection per request),
+        # and the 1s TCP retransmit turns into a phantom 1000ms p50 —
+        # the listen queue must absorb a burst of the whole client
+        # fleet.  Subclassed so the stdlib class is left untouched.
+        class _Server(ThreadingHTTPServer):
+            request_queue_size = 128
+
+        self._httpd = _Server((self._bind, self._port), handler)
         if self._tls_cert:
             # TLS termination (x/tls_helper.go analog): stdlib ssl, TLS1.2+.
             # do_handshake_on_connect=False moves the handshake off the
@@ -152,6 +183,10 @@ class DgraphServer:
                 self._httpd.shutdown()
                 self._httpd.server_close()
                 self._httpd = None
+            if self.scheduler is not None:
+                # before the write lock: queued cohorts must drain (fail
+                # fast) or they would wait on a read lock that never comes
+                self.scheduler.stop()
             with self._engine_lock.write():
                 if self.cluster is not None:
                     self.cluster.stop()
@@ -161,15 +196,26 @@ class DgraphServer:
 
     # -- request execution -------------------------------------------------
 
-    def run_query(self, text: str, variables: Optional[dict] = None, debug: bool = False) -> dict:
+    def run_query(
+        self,
+        text: str,
+        variables: Optional[dict] = None,
+        debug: bool = False,
+        timeout_s: Optional[float] = None,
+    ) -> dict:
         """The ParseQueryAndMutation → ProcessWithMutation → encode path
-        with the reference's latency breakdown (query/query.go:102)."""
+        with the reference's latency breakdown (query/query.go:102).
+
+        ``timeout_s`` is the caller's remaining budget (gRPC deadline /
+        X-Dgraph-Timeout header): a scheduled request past it sheds with
+        SchedDeadlineError instead of sitting in a cohort queue."""
         from dgraph_tpu import gql
 
         NUM_QUERIES.add(1)
         PENDING_QUERIES.add(1)
         tr = self.tracer.begin()
         lat = Latency()
+        t0 = __import__("time").monotonic()
         try:
             parsed = gql.parse(text, variables)
             lat.record_parsing()
@@ -178,11 +224,25 @@ class DgraphServer:
             out: dict = {}
             from dgraph_tpu.query import outputnode
 
-            debug_token = outputnode.DEBUG_UIDS.set(debug)
-            try:
-                stats = self._run_locked(parsed, out)
-            finally:
-                outputnode.DEBUG_UIDS.reset(debug_token)
+            if self.scheduler is not None and parsed.mutation is None:
+                # read-only: ride a cohort (the scheduler's member thread
+                # sets DEBUG_UIDS for the encode; writes and profiled
+                # runs keep the exclusive path below, untouched).  The
+                # key makes equal requests singleflight-coalescible.
+                vkey = (
+                    json.dumps(variables, sort_keys=True) if variables else ""
+                )
+                result, stats = self.scheduler.run(
+                    parsed, debug=debug, timeout_s=timeout_s,
+                    key=(text, vkey, debug),
+                )
+                out.update(result)
+            else:
+                debug_token = outputnode.DEBUG_UIDS.set(debug)
+                try:
+                    stats = self._run_locked(parsed, out)
+                finally:
+                    outputnode.DEBUG_UIDS.reset(debug_token)
             lat.record_processing()
             tr.printf("processed")
             # json encode happens in the handler; pre-record here so the
@@ -194,7 +254,12 @@ class DgraphServer:
                 # chain time + edges traversed) — the per-query profile
                 # surface (reference: --trace + pprof, main.go:181).
                 # ``stats`` comes from this request's own engine shell,
-                # so concurrent queries can't clobber it.
+                # so concurrent queries can't clobber it.  Caveat under
+                # the cohort scheduler: a hop MERGED across sessions
+                # (HopMerger) attributes the whole union's edge count
+                # and device time to the member that led the dispatch —
+                # cohort-attributed, not per-request; DGRAPH_TPU_SCHED=0
+                # restores exact per-request accounting.
                 out["server_latency"]["engine"] = {
                     k: (round(v, 3) if isinstance(v, float) else v)
                     for k, v in stats.items()
@@ -202,6 +267,7 @@ class DgraphServer:
             return out
         finally:
             PENDING_QUERIES.add(-1)
+            QUERY_LATENCY.observe(__import__("time").monotonic() - t0)
             self.tracer.finish(tr, "query", text[:120])
 
     _dump_seq = __import__("itertools").count()
@@ -492,7 +558,16 @@ def _make_handler(srv: DgraphServer):
                 try:
                     vars_hdr = self.headers.get("X-Dgraph-Vars")
                     variables = json.loads(vars_hdr) if vars_hdr else None
-                    out = srv.run_query(body, variables, debug=debug)
+                    # request budget (seconds): propagated into the cohort
+                    # scheduler's per-request deadline
+                    tmo_hdr = self.headers.get("X-Dgraph-Timeout")
+                    try:
+                        timeout_s = float(tmo_hdr) if tmo_hdr else None
+                    except ValueError:
+                        timeout_s = None
+                    out = srv.run_query(
+                        body, variables, debug=debug, timeout_s=timeout_s
+                    )
                     accept = self.headers.get("Accept", "")
                     if "application/protobuf" in accept or "application/x-protobuf" in accept:
                         # binary client surface: protobuf wire-format
@@ -505,6 +580,15 @@ def _make_handler(srv: DgraphServer):
                         )
                     else:
                         self._reply(200, json.dumps(out).encode())
+                except SchedOverloadError as e:
+                    # shed under overload: retriable, not a client error
+                    self._reply(429, json.dumps(
+                        {"code": "ErrorServiceUnavailable", "message": str(e)}
+                    ).encode())
+                except SchedDeadlineError as e:
+                    self._reply(504, json.dumps(
+                        {"code": "ErrorDeadlineExceeded", "message": str(e)}
+                    ).encode())
                 except Exception as e:
                     self._err(400, str(e))
             elif u.path == "/share":

@@ -8,6 +8,7 @@ Prometheus text exposition endpoint instead of expvar bridging.
 from dgraph_tpu.utils.metrics import (
     Counter,
     Gauge,
+    Histogram,
     MetricsRegistry,
     metrics,
 )
@@ -19,6 +20,7 @@ from dgraph_tpu.utils.config import Options
 __all__ = [
     "Counter",
     "Gauge",
+    "Histogram",
     "MetricsRegistry",
     "metrics",
     "RequestTrace",

@@ -71,12 +71,62 @@ class LabeledCounter:
             return dict(self._m)
 
 
+class Histogram:
+    """Fixed-bucket histogram with Prometheus `_bucket{le=...}` / `_sum` /
+    `_count` exposition (the prometheus client_golang Histogram shape; the
+    reference bridges expvar and loses distributions — queue-wait and
+    end-to-end latency need percentiles, not means)."""
+
+    __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, name: str, buckets):
+        self.name = name
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        # per-bucket (non-cumulative) counts; +Inf bucket is the tail slot
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        from bisect import bisect_left
+
+        i = bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self):
+        """(cumulative bucket counts aligned with self.buckets + [+Inf],
+        sum, count) — one consistent view."""
+        with self._lock:
+            counts = list(self._counts)
+            s, c = self._sum, self._count
+        cum = []
+        run = 0
+        for n in counts:
+            run += n
+            cum.append(run)
+        return cum, s, c
+
+    def count(self) -> int:
+        return self._count
+
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum / self._count if self._count else 0.0
+
+
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._labeled: Dict[str, LabeledCounter] = {}
+        self._histograms: Dict[str, Histogram] = {}
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -99,6 +149,13 @@ class MetricsRegistry:
                 l = self._labeled[name] = LabeledCounter(name, label)
             return l
 
+    def histogram(self, name: str, buckets) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name, buckets)
+            return h
+
     def prometheus_text(self) -> str:
         """Prometheus text exposition format (the collector at
         x/metrics.go:119 re-done natively)."""
@@ -107,6 +164,7 @@ class MetricsRegistry:
             counters = list(self._counters.values())
             gauges = list(self._gauges.values())
             labeled = list(self._labeled.values())
+            histograms = list(self._histograms.values())
         for c in sorted(counters, key=lambda c: c.name):
             lines.append(f"# TYPE {c.name} counter")
             lines.append(f"{c.name} {c.value()}")
@@ -118,6 +176,14 @@ class MetricsRegistry:
             for k, v in sorted(l.snapshot().items()):
                 esc = k.replace("\\", "\\\\").replace('"', '\\"')
                 lines.append(f'{l.name}{{{l.label}="{esc}"}} {v}')
+        for h in sorted(histograms, key=lambda h: h.name):
+            cum, s, c = h.snapshot()
+            lines.append(f"# TYPE {h.name} histogram")
+            for b, n in zip(h.buckets, cum):
+                lines.append(f'{h.name}_bucket{{le="{b:g}"}} {n}')
+            lines.append(f'{h.name}_bucket{{le="+Inf"}} {c}')
+            lines.append(f"{h.name}_sum {s:g}")
+            lines.append(f"{h.name}_count {c}")
         return "\n".join(lines) + "\n"
 
 
@@ -138,3 +204,27 @@ NUM_GRPC_RUNS = metrics.counter("dgraph_grpc_runs_total")
 NUM_GRPC_RAFT = metrics.counter("dgraph_grpc_raft_frames_total")
 MAX_PL_LENGTH = metrics.gauge("dgraph_max_posting_list_length")
 PREDICATE_STATS = metrics.labeled("dgraph_predicate_mutations_total")
+
+# latency bucket ladder shared by the serving histograms (seconds):
+# sub-ms through 10s, roughly ×2.5 steps — the client_golang DefBuckets
+_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+# cohort scheduler surface (sched/scheduler.py): how full cohorts ride,
+# why they flushed, how long requests queued, end-to-end query latency
+QUERY_LATENCY = metrics.histogram(
+    "dgraph_query_latency_seconds", _LATENCY_BUCKETS
+)
+SCHED_QUEUE_WAIT = metrics.histogram(
+    "dgraph_sched_queue_wait_seconds", _LATENCY_BUCKETS
+)
+SCHED_COHORT_OCCUPANCY = metrics.histogram(
+    "dgraph_sched_cohort_occupancy", (1, 2, 4, 8, 16, 32, 64, 128)
+)
+SCHED_FLUSHES = metrics.labeled("dgraph_sched_flushes_total", label="reason")
+SCHED_SHED = metrics.labeled("dgraph_sched_shed_total", label="reason")
+SCHED_MERGED_HOPS = metrics.counter("dgraph_sched_merged_hops_total")
+SCHED_COALESCED = metrics.counter("dgraph_sched_coalesced_requests_total")
+SCHED_QUEUE_DEPTH = metrics.gauge("dgraph_sched_queue_depth")

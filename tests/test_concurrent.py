@@ -117,7 +117,9 @@ def srv():
 def test_two_queries_execute_concurrently(srv, monkeypatch):
     """Both requests must be INSIDE engine execution at once: each waits at
     a 2-party barrier inside run_parsed — under the old exclusive lock
-    this deadlocks; under the RW lock both enter and the barrier trips."""
+    this deadlocks; under the RW lock both enter and the barrier trips.
+    The two texts differ (alias) so the cohort scheduler's singleflight
+    cannot legally collapse them into one execution."""
     from dgraph_tpu.query.engine import QueryEngine
 
     barrier = threading.Barrier(2, timeout=10)
@@ -132,13 +134,15 @@ def test_two_queries_execute_concurrently(srv, monkeypatch):
     results = []
     errs = []
 
-    def q():
+    def q(alias):
         try:
-            results.append(_post(srv.addr, '{ q(func: uid(0x1)) { name } }'))
+            results.append(
+                _post(srv.addr, '{ %s(func: uid(0x1)) { name } }' % alias)
+            )
         except Exception as e:  # pragma: no cover
             errs.append(e)
 
-    ts = [threading.Thread(target=q) for _ in range(2)]
+    ts = [threading.Thread(target=q, args=(a,)) for a in ("q", "r")]
     for t in ts:
         t.start()
     for t in ts:
@@ -146,7 +150,7 @@ def test_two_queries_execute_concurrently(srv, monkeypatch):
     assert not errs
     assert len(results) == 2
     for r in results:
-        assert r["q"] == [{"name": "Alice"}]
+        assert list(r.values())[0] == [{"name": "Alice"}]
 
 
 def test_reads_correct_during_mutations(srv):
