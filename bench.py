@@ -26,10 +26,14 @@ dead-code elimination.
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline",
 "fused_hop", "hop_dedup", "serving", ...}.  "serving" is the closed-loop
-multi-client A/B (run_serving_bench): the same 2-hop workload through
-the cohort scheduler (DGRAPH_TPU_SCHED=1) and the serial per-request
-path (=0), with QPS, p50/p99 latency, mean cohort occupancy,
-flush-reason counts and a response-parity check.
+multi-client A/B (run_serving_bench), three arms over one zipf workload:
+the cohort scheduler (DGRAPH_TPU_SCHED=1) vs the serial per-request
+path (=0), both cache-off, plus the two-tier query cache arm
+(DGRAPH_TPU_CACHE=1, ISSUE 3) reported as "cache_on" with
+"cache_qps_ratio" (warm-QPS over the cache-off scheduler arm) and
+"tier2_hit_rate" (guarded nonzero) — with QPS, p50/p99 latency, mean
+cohort occupancy, flush-reason counts and a cross-arm response-parity
+check.
 Environment knobs: BENCH_NODES, BENCH_EDGES, BENCH_SEEDS, BENCH_ITERS,
 BENCH_SCALE (shrink everything by a factor: 0.1 -> 200k nodes / 2.1M
 edges), BENCH_DEDUP (host|device|auto), BENCH_PROBE_BUDGET /
@@ -415,14 +419,19 @@ def _serving_store(n_nodes: int, deg: int, seed: int = 13):
     return store
 
 
-def _serving_mode(sched_on: bool, store, variants, clients: int, secs: float):
+def _serving_mode(
+    sched_on: bool, store, variants, clients: int, secs: float,
+    cache_on: bool = False,
+):
     """One closed-loop run: ``clients`` threads fire queries for ``secs``
-    against a fresh DgraphServer (scheduler gated by ``sched_on``).
+    against a fresh DgraphServer (scheduler gated by ``sched_on``, the
+    two-tier query cache by ``cache_on``).
     Returns (qps, p50_ms, p99_ms, {query: response}, completed)."""
     import json as _json
     import threading
 
     os.environ["DGRAPH_TPU_SCHED"] = "1" if sched_on else "0"
+    os.environ["DGRAPH_TPU_CACHE"] = "1" if cache_on else "0"
     from dgraph_tpu.serve.server import DgraphServer
 
     srv = DgraphServer(store)
@@ -514,9 +523,14 @@ def _serving_mode(sched_on: bool, store, variants, clients: int, secs: float):
 
 
 def run_serving_bench():
-    """Closed-loop multi-client serving benchmark (ISSUE 2): the same
-    workload through the cohort scheduler (DGRAPH_TPU_SCHED=1) and the
-    serial per-request path (=0), with response-parity checking.
+    """Closed-loop multi-client serving benchmark (ISSUE 2 + ISSUE 3):
+    three arms over the same zipf workload with response-parity checks —
+    scheduler on (cache off) vs the serial per-request path (the PR 2
+    batching A/B, both cache-off so the ratio still isolates batching),
+    plus the two-tier query cache on (ISSUE 3's warm-path A/B: cache_on
+    vs the cache-off scheduler arm).  Guards that the cache-on arm's
+    tier-2 hit rate is nonzero — a zipf head that never hits means the
+    cache is mis-keyed, and the headline ratio would be a lie.
     Returns the dict merged into the headline JSON under "serving"."""
     clients = int(os.environ.get("BENCH_CLIENTS", 32))
     secs = float(os.environ.get("BENCH_SERVE_SECONDS", 4.0))
@@ -536,17 +550,24 @@ def run_serving_bench():
 
     from statistics import median
 
-    from dgraph_tpu.utils.metrics import SCHED_COHORT_OCCUPANCY, SCHED_FLUSHES
+    from dgraph_tpu.utils.metrics import (
+        QCACHE_RESULT_EVENTS,
+        SCHED_COHORT_OCCUPANCY,
+        SCHED_FLUSHES,
+    )
 
     reps = max(1, int(os.environ.get("BENCH_SERVE_REPS", 2)))
     _occ0, occ_sum0, c0 = SCHED_COHORT_OCCUPANCY.snapshot()
     fl0 = SCHED_FLUSHES.snapshot()
+    qc0 = QCACHE_RESULT_EVENTS.snapshot()
     # interleave the modes: the shared host's load swings throughput ~2×
     # between runs (same caveat as the headline bench), so paired runs +
-    # medians are the only defensible comparison
-    on_runs, off_runs = [], []
-    canon_on = canon_off = None
-    n_on = n_off = 0
+    # medians are the only defensible comparison.  The two sched arms run
+    # CACHE-OFF so their ratio still isolates the batching win; the cache
+    # arm compares against the cache-off scheduler arm.
+    on_runs, off_runs, cache_runs = [], [], []
+    canon_on = canon_off = canon_cache = None
+    n_on = n_off = n_cache = 0
     for _ in range(reps):
         qps, p50, p99, canon_on, n1 = _serving_mode(
             True, store, variants, clients, secs
@@ -558,15 +579,31 @@ def run_serving_bench():
         )
         off_runs.append((qps, p50, p99))
         n_off += n2
+        qps, p50, p99, canon_cache, n3 = _serving_mode(
+            True, store, variants, clients, secs, cache_on=True
+        )
+        cache_runs.append((qps, p50, p99))
+        n_cache += n3
     _occ1, occ_sum1, c1 = SCHED_COHORT_OCCUPANCY.snapshot()
     fl1 = SCHED_FLUSHES.snapshot()
-    identical = canon_on == canon_off
-    assert identical, "sched on/off responses diverged"
+    qc1 = QCACHE_RESULT_EVENTS.snapshot()
+    identical = canon_on == canon_off == canon_cache
+    assert identical, "sched/cache arm responses diverged"
+    # tier-2 guard: the zipf head MUST hit (nonzero hit rate) or the
+    # cache arm measured nothing
+    t2_hits = qc1.get("hit", 0) - qc0.get("hit", 0)
+    t2_miss = qc1.get("miss", 0) - qc0.get("miss", 0)
+    t2_rate = t2_hits / max(t2_hits + t2_miss, 1)
+    assert t2_hits > 0, (
+        "cache-on serving arm reported a ZERO tier-2 hit rate under the "
+        "zipf workload — the result cache never engaged"
+    )
     flushes = {k: fl1.get(k, 0) - fl0.get(k, 0) for k in fl1}
     flushes = {k: v for k, v in flushes.items() if v}
     n_flush = max(c1 - c0, 1)
     qps_on = median(r[0] for r in on_runs)
     qps_off = median(r[0] for r in off_runs)
+    qps_cache = median(r[0] for r in cache_runs)
     return {
         "clients": clients,
         "seconds": secs,
@@ -585,7 +622,18 @@ def run_serving_bench():
             "qps_runs": [round(r[0], 1) for r in off_runs],
             "requests": n_off,
         },
+        "cache_on": {
+            "qps": round(qps_cache, 1),
+            "p50_ms": round(median(r[1] for r in cache_runs), 2),
+            "p99_ms": round(median(r[2] for r in cache_runs), 2),
+            "qps_runs": [round(r[0], 1) for r in cache_runs],
+            "requests": n_cache,
+        },
         "qps_ratio": round(qps_on / qps_off, 3) if qps_off else None,
+        # ISSUE 3 headline: warm-QPS ratio, cache-on over the cache-off
+        # scheduler arm (same sched config, only DGRAPH_TPU_CACHE flips)
+        "cache_qps_ratio": round(qps_cache / qps_on, 3) if qps_on else None,
+        "tier2_hit_rate": round(t2_rate, 4),
         "cohort_occupancy_mean": round((occ_sum1 - occ_sum0) / n_flush, 2),
         "flush_reasons": flushes,
         "responses_identical": identical,

@@ -1,0 +1,153 @@
+"""Tier 1: hop-expansion memoization at the DeviceExpander seam.
+
+The engine's per-level expansion — ``(arena, predicate, direction,
+frontier) → (out_flat, seg_ptr)`` — is deterministic over an immutable
+arena snapshot (the property the cohort HopMerger already relies on to
+deal union expansions back byte-identically, sched/cohort.py).  That
+makes it memoizable: key the call by ``(arena identity, predicate,
+direction, frontier digest, store version)`` and a repeat hop under an
+unchanged store returns the SAME arrays with zero device work — no
+dispatch, no transport round trip, no compile-cache probe.  Under PR
+2's zipf serving workload the head queries re-execute the same hops
+thousands of times against an unchanged store; this tier converts each
+of those re-executions into a dict probe.
+
+A hit must short-circuit BEFORE dispatch so the existing compile-count
+guards hold (a cached hop adds zero programs by construction).
+
+On residency: the expander's contract returns the one host fetch the
+packed device paths already concatenate into a single transfer
+(query/engine.py `_packed_*`), and every downstream consumer is host
+code.  Caching THOSE arrays — rather than device handles — means a hit
+pays no device interaction at all: the round trip was paid once at
+fill time, and a device-array entry would force a fresh device→host
+fetch per hit (strictly worse on every backend, catastrophically so
+through a remote-transport tunnel).  Entries pin host RAM, not HBM, so
+the byte budget rides beside the arena budget instead of competing
+with it.  Entries hold exactly the arrays the expansion returned — the
+engine treats
+(out_flat, seg_ptr) as immutable (every downstream transform allocates
+fresh arrays: masks, windows, permutations), so sharing is safe the
+same way HopMerger's dealt segments and the scheduler's singleflight
+results are.
+
+Eviction: byte-budgeted LFU-with-aging (cache/core.py) so one
+megaquery's giant frontier cannot walk the hot head out; explicit drop
+when the ArenaManager evicts an arena (models/arena.py) so a rebuilt
+arena at a recycled ``id()`` can never alias a dead entry's key.
+
+Knobs: ``DGRAPH_TPU_CACHE`` (shared gate), ``DGRAPH_TPU_CACHE_HOP_BYTES``
+(budget, default 64 MiB, 0 disables this tier only).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dgraph_tpu.cache.core import VersionedLFUCache, env_bytes
+from dgraph_tpu.utils.metrics import (
+    QCACHE_HIT_AGE,
+    QCACHE_HOP_BYTES,
+    QCACHE_HOP_EVENTS,
+)
+
+_DEFAULT_BUDGET = 64 << 20
+
+
+def frontier_digest(src: np.ndarray) -> bytes:
+    """Order-sensitive digest of a frontier uid array (expansion output
+    depends on row order, so permutations must NOT collide)."""
+    a = np.ascontiguousarray(src, dtype=np.int64)
+    h = hashlib.blake2b(a.tobytes(), digest_size=16)
+    return h.digest()
+
+
+class HopCache:
+    """One per ArenaManager (per store): expansions are arena-snapshot
+    state, exactly like the arenas themselves."""
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        self._c = VersionedLFUCache(
+            budget_bytes=(
+                budget_bytes
+                if budget_bytes is not None
+                else env_bytes("DGRAPH_TPU_CACHE_HOP_BYTES", _DEFAULT_BUDGET)
+            ),
+            stats_hook=self._on_event,
+        )
+
+    def _on_event(self, event: str, entry) -> None:
+        QCACHE_HOP_EVENTS.add(event)
+        QCACHE_HOP_BYTES.set(self._c.occupancy_bytes)
+
+    # -- introspection (tests / bench) -------------------------------------
+
+    @property
+    def occupancy_bytes(self) -> int:
+        return self._c.occupancy_bytes
+
+    @property
+    def max_entry_bytes(self) -> int:
+        """Per-entry admission cap — the expander pre-screens on the
+        ESTIMATED result size so a hopeless megaquery never even pays
+        for the frontier digest."""
+        return self._c.max_entry_bytes
+
+    def __len__(self) -> int:
+        return len(self._c)
+
+    # -- the seam -----------------------------------------------------------
+
+    def key_for(self, arena, attr: str, reverse: bool, src: np.ndarray):
+        """Precompute the entry key — the digest is the expensive part
+        (big frontiers hash megabytes), and a miss needs the SAME key
+        for its fill put, so the expander computes it once per call."""
+        return (id(arena), attr, bool(reverse), frontier_digest(src))
+
+    def get(
+        self, arena, attr: str, reverse: bool, src: np.ndarray, version: int,
+        key=None,
+    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        if key is None:
+            key = self.key_for(arena, attr, reverse, src)
+        hit = self._c.get(key, version)
+        if hit is None:
+            return None
+        value, age = hit
+        QCACHE_HIT_AGE.observe(age)
+        return value
+
+    def put(
+        self,
+        arena,
+        attr: str,
+        reverse: bool,
+        src: np.ndarray,
+        version: int,
+        out: np.ndarray,
+        seg_ptr: np.ndarray,
+        key=None,
+    ) -> None:
+        if key is None:
+            key = self.key_for(arena, attr, reverse, src)
+        nbytes = int(out.nbytes) + int(seg_ptr.nbytes) + 64
+        self._c.put(key, version, (out, seg_ptr), nbytes)
+        # admissions and sweeps change occupancy without a get-event
+        QCACHE_HOP_BYTES.set(self._c.occupancy_bytes)
+
+    # -- invalidation --------------------------------------------------------
+
+    def drop_arena(self, arena_id: int) -> int:
+        """Explicit drop when the ArenaManager evicts (or rebuilds) an
+        arena: its ``id()`` may be recycled by a LATER allocation, and
+        id-keyed entries must never outlive the object they describe."""
+        n = self._c.drop_where(lambda k: k[0] == arena_id)
+        QCACHE_HOP_BYTES.set(self._c.occupancy_bytes)
+        return n
+
+    def clear(self) -> None:
+        self._c.clear()
+        QCACHE_HOP_BYTES.set(0)

@@ -24,30 +24,33 @@ from dgraph_tpu.client import (
 from dgraph_tpu.client.client import Transport
 
 
-def _make_transport(addr: str, use_grpc: bool) -> Transport:
+def _make_transport(addr: str, use_grpc: bool, cafile: str = "") -> Transport:
     """One server's transport: gRPC (the reference loader's native wire,
     cmd/dgraphloader/main.go:222 grpc conns) or HTTP.  gRPC targets may
-    be given bare (host:port) or as http://host:port (mapped to the
-    +1000 convention)."""
+    be given bare (host:port) or as http(s)://host:port (mapped to the
+    +1000 convention); https-derived targets need ``cafile`` (--ca) and
+    dial TLS-verified (GrpcTransport's pinned-CA path — a --tls_cert
+    server would otherwise fail every RPC)."""
     if not use_grpc:
         return HttpTransport(addr)
     from dgraph_tpu.client import GrpcTransport
 
-    if addr.startswith(("http://", "https://")):
-        from dgraph_tpu.cluster.transport import grpc_target_of
-
-        addr = grpc_target_of(addr, 1000)
-    return GrpcTransport(addr)
+    # the CA applies only to https-derived targets: handing it to a
+    # plaintext member of a mixed fleet would dial TLS into a plaintext
+    # listener and fail every RPC with an opaque UNAVAILABLE
+    return GrpcTransport(
+        addr, cafile=cafile if addr.startswith("https://") else ""
+    )
 
 
 class RoundRobinTransport(Transport):
     """Spread requests over several servers (loader main.go:222)."""
 
-    def __init__(self, addrs, use_grpc: bool = False):
+    def __init__(self, addrs, use_grpc: bool = False, cafile: str = ""):
         import itertools
         import threading
 
-        self._ts = [_make_transport(a, use_grpc) for a in addrs]
+        self._ts = [_make_transport(a, use_grpc, cafile) for a in addrs]
         self._next = itertools.cycle(self._ts)
         self._lock = threading.Lock()
 
@@ -145,14 +148,18 @@ def main(argv=None) -> int:
                    help="client checkpoint dir (enables resume)")
     p.add_argument("--grpc", action="store_true",
                    help="connect over gRPC (protos.Dgraph/Run) instead of "
-                        "HTTP; http:// addresses map to port + 1000")
+                        "HTTP; http(s):// addresses map to port + 1000")
+    p.add_argument("--ca", default="",
+                   help="pinned CA / server-cert PEM for https gRPC "
+                        "targets (a --tls_cert server serves gRPC over "
+                        "TLS; required with https:// + --grpc)")
     ns = p.parse_args(argv)
 
     addrs = [a.strip() for a in ns.dgraph.split(",") if a.strip()]
     transport = (
-        RoundRobinTransport(addrs, use_grpc=ns.grpc)
+        RoundRobinTransport(addrs, use_grpc=ns.grpc, cafile=ns.ca)
         if len(addrs) > 1
-        else _make_transport(addrs[0], ns.grpc)
+        else _make_transport(addrs[0], ns.grpc, ns.ca)
     )
     client = DgraphClient(
         transport, BatchMutationOptions(size=ns.batch, pending=ns.concurrent)

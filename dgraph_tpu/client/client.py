@@ -85,17 +85,35 @@ class GrpcTransport(Transport):
     client's native wire (client/client.go over protos.Dgraph/Run).
     Channels come from a shared refcounted pool with a CheckVersion
     liveness probe (the worker/conn.go:108 pool analog); call close()
-    to release this transport's reference."""
+    to release this transport's reference.
+
+    ``target`` is a bare host:port, or an http(s):// server address
+    (mapped to the +1000 gRPC port convention).  A server started with
+    --tls_cert serves gRPC over TLS, so https-derived targets require
+    ``cafile`` (its cert / a pinned CA, PEM) and dial a verified
+    grpc.secure_channel — mirroring GrpcRaftTransport: there is no
+    silent plaintext downgrade and no unverified-TLS mode."""
 
     _pool = None  # class-level shared ChannelPool
 
-    def __init__(self, target: str):
+    def __init__(self, target: str, cafile: str = ""):
         from dgraph_tpu.serve.grpc_server import ChannelPool
 
         if GrpcTransport._pool is None:
             GrpcTransport._pool = ChannelPool()
+        if "://" in target:
+            from dgraph_tpu.cluster.transport import grpc_target_of
+
+            if target.startswith("https://") and not cafile:
+                raise ValueError(
+                    "https gRPC targets require cafile= (the server's "
+                    "TLS cert or a pinned CA): dialing plaintext into a "
+                    "--tls_cert server fails every RPC"
+                )
+            target = grpc_target_of(target, 1000)
         self.target = target
-        self._chan = GrpcTransport._pool.get(target)
+        self.cafile = cafile
+        self._chan = GrpcTransport._pool.get(target, cafile or None)
         self._run = self._chan.unary_unary("/protos.Dgraph/Run")
         self._check = self._chan.unary_unary("/protos.Dgraph/CheckVersion")
         self._assign = self._chan.unary_unary("/protos.Dgraph/AssignUids")
@@ -127,7 +145,7 @@ class GrpcTransport(Transport):
 
     def close(self) -> None:
         if self._chan is not None:
-            GrpcTransport._pool.release(self.target)
+            GrpcTransport._pool.release(self.target, self.cafile or None)
             self._chan = None
 
 

@@ -639,6 +639,15 @@ class ClusterStore:
             self._drain_dirty()
             return self._dirty
 
+    # remote TTL-cached predicates refresh WITHOUT a version bump, and
+    # those refreshes only fire during query execution — a tier-2 result
+    # cache hit (which skips execution) would therefore starve the
+    # freshness probe and serve the stale copy forever.  Declaring the
+    # version non-strict keeps tier 2 off for clustered reads; tier 1
+    # stays on (arena identity keys it, and a remote refresh marks the
+    # predicate dirty → the arena rebuilds under a new identity).
+    strict_snapshot_versions = False
+
     @property
     def version(self) -> int:
         """Snapshot version for the cohort scheduler's admission

@@ -921,7 +921,12 @@ _REGEXP_ARG_RE = re.compile(
 
 
 _MUT_TOK_RE = re.compile(
-    r'"(?:\\.|[^"\\])*(?:"|\Z)|#[^\n]*|[{}]|mutation'
+    # string-literal token is LINE-bounded, like _LINE_TOK_RE's: an
+    # unterminated quote must swallow at most the rest of its line, or
+    # this tokenizer and _match_brace disagree about brace nesting (a
+    # multi-line string here would hide real braces — and a genuine
+    # top-level `mutation {` — that _match_brace still counts)
+    r'"(?:\\.|[^"\\\n])*(?:"|(?=\n)|\Z)|#[^\n]*|[{}]|mutation'
 )
 
 

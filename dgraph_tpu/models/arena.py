@@ -628,6 +628,14 @@ class ArenaManager:
         )
         self._lru: "_OD[tuple, int]" = _OD()  # (cache id, key) -> bytes
         self._lru_total = 0  # running sum of _lru values (O(1) touches)
+        # tier-1 hop-expansion cache (dgraph_tpu/cache/hop.py): expansion
+        # results are arena-snapshot state, so the cache lives and dies
+        # with this manager and must hear about arena evictions below
+        # (id-keyed entries may never outlive the arena object).  None
+        # when DGRAPH_TPU_CACHE=0 — the expander then skips every probe.
+        from dgraph_tpu.cache import HopCache, cache_enabled
+
+        self.hop_cache = HopCache() if cache_enabled() else None
         self._caches_by_id = {
             id(self._data): self._data,
             id(self._reverse): self._reverse,
@@ -707,8 +715,12 @@ class ArenaManager:
             self._lru.pop(victim)
             self._lru_total -= vbytes
             cache = self._caches_by_id.get(victim[0])
-            if cache is not None:
-                cache.pop(victim[1], None)
+            gone = cache.pop(victim[1], None) if cache is not None else None
+            if gone is not None and self.hop_cache is not None:
+                # tier-1 entries are keyed by id(arena): drop them NOW,
+                # while the object is still alive, or a later allocation
+                # recycling the id could alias a dead entry's key
+                self.hop_cache.drop_arena(id(gone))
             if cache is self._data or cache is self._reverse:
                 skey = (victim[1], cache is self._reverse)
                 if skey in self._sharded:
@@ -733,6 +745,8 @@ class ArenaManager:
         # remove marks we actually processed, so a racing mark survives
         # for the next refresh.
         if "*" in dirty:  # full-store replacement (snapshot restore)
+            if self.hop_cache is not None:
+                self.hop_cache.clear()
             self._data.clear()
             self._reverse.clear()
             self._values.clear()
@@ -750,9 +764,13 @@ class ArenaManager:
                 dirty.discard(p)
                 continue
             for key in [k for k in self._data if k == p or k.startswith(p + "\x00")]:
-                self._data.pop(key, None)
+                gone = self._data.pop(key, None)
+                if gone is not None and self.hop_cache is not None:
+                    self.hop_cache.drop_arena(id(gone))
                 self._lru_drop(self._data, key)
-            self._reverse.pop(p, None)
+            gone = self._reverse.pop(p, None)
+            if gone is not None and self.hop_cache is not None:
+                self.hop_cache.drop_arena(id(gone))
             self._lru_drop(self._reverse, p)
             self._values.pop(p, None)
             self._lru_drop(self._values, p)

@@ -144,15 +144,31 @@ class PostingStore:
     # posting/lists.go:109-215)
     DELTA_MAX = 65536
 
+    # version covers EVERY observable change: anything readable through
+    # this store changes only via a version bump.  The tier-2 result
+    # cache (cache/result.py) requires this — a hit short-circuits
+    # execution entirely, so any freshness mechanism that piggybacks on
+    # execution (ClusterStore's remote-TTL pulls) would starve behind a
+    # warm cache.  Stores with such eventually-consistent side channels
+    # must override this to False (ClusterStore does); tier 1 stays safe
+    # there regardless because arena identity is part of its key and
+    # remote refreshes rebuild arenas.
+    strict_snapshot_versions = True
+
     def __init__(self, schema: Optional[SchemaState] = None):
         self.schema = schema if schema is not None else SchemaState()
         self.uids = UidMap()
         self._preds: Dict[str, PredicateData] = {}
         self.dirty: Set[str] = set()
         # monotonic snapshot version: bumps on every mutation batch so
-        # readers (the cohort scheduler's admission signature,
-        # sched/cohort.py) can tell "same immutable arena snapshot"
-        # apart without hashing store state
+        # readers can tell "same immutable arena snapshot" apart without
+        # hashing store state.  Consumers: the cohort scheduler's
+        # admission signature (sched/cohort.py) and BOTH query-cache
+        # tiers (dgraph_tpu/cache/ — every entry is keyed under the
+        # version it was computed at, so a bump is a global O(1)
+        # invalidation; see cache/core.py).  Anything that changes query
+        # results MUST bump it — apply/apply_many, the bulk setters,
+        # apply_schema and delete_predicate all do.
         self.version = 0
         # pred -> [(src, dst, +1|-1), ...] since the last arena refresh;
         # None = overflowed (full rebuild required).  Only uid-edge ops

@@ -133,20 +133,50 @@ class DeviceExpander:
     def expand(
         self, arena, src: np.ndarray, attr: str = "", reverse: bool = False
     ) -> Tuple[np.ndarray, np.ndarray]:
-        """Per-level expansion entry: routes through the cohort hop
-        merger when one is installed (cross-session dispatch coalescing)
-        AND the expansion is big enough to be device-routed — merging a
-        host-path numpy expansion costs more in union bookkeeping than
-        the per-call overhead it saves, while a device dispatch
-        (~100µs-1ms of fixed cost) amortizes beautifully."""
+        """Per-level expansion entry: tier-1 hop cache first (a repeat
+        expansion over an unchanged store snapshot returns the memoized
+        arrays — zero dispatch, zero transport, zero new programs, so
+        the compile-count guards hold by construction), then the cohort
+        hop merger when one is installed (cross-session dispatch
+        coalescing) AND the expansion is big enough to be device-routed
+        — merging a host-path numpy expansion costs more in union
+        bookkeeping than the per-call overhead it saves, while a device
+        dispatch (~100µs-1ms of fixed cost) amortizes beautifully."""
+        hc = self.engine.arenas.hop_cache
+        ver = hkey = None
+        if hc is not None and attr and len(src):
+            # pre-screen on the ESTIMATED result bytes: a frontier whose
+            # expansion cannot be admitted (LFU-with-aging refuses
+            # over-cap entries so one megaquery can't evict the hot
+            # head) should not even pay for the digest
+            est = (len(src) + len(src) * arena.avg_degree) * 8
+            if est <= hc.max_entry_bytes:
+                ver = getattr(self.engine.store, "version", None)
+        if ver is not None:
+            # one digest per call: the miss path re-uses it for the fill
+            hkey = hc.key_for(arena, attr, reverse, src)
+            cached = hc.get(arena, attr, reverse, src, ver, key=hkey)
+            if cached is not None:
+                self.engine.stats["edges"] += len(cached[0])
+                return cached
         if (
             self.hop_merger is not None
             and attr
             and len(src)
             and len(src) * arena.avg_degree >= self.engine.expand_device_min
         ):
-            return self.submit_hop(arena, src, attr, reverse)
-        return self._expand_one(arena, src, attr=attr, reverse=reverse)
+            out, seg_ptr = self.submit_hop(arena, src, attr, reverse)
+        else:
+            out, seg_ptr = self._expand_one(
+                arena, src, attr=attr, reverse=reverse
+            )
+        if ver is not None:
+            # ``ver`` was read BEFORE the expansion: if a mutation raced
+            # us (embedded engines without the server's read lock), the
+            # entry lands under the older version and can never be hit
+            # — stale-keyed, not stale-served
+            hc.put(arena, attr, reverse, src, ver, out, seg_ptr, key=hkey)
+        return out, seg_ptr
 
     def submit_hop(
         self, arena, src: np.ndarray, attr: str = "", reverse: bool = False

@@ -38,12 +38,21 @@ RESOURCE_EXHAUSTED), and requests whose deadline lapses while queued
 shed with `SchedDeadlineError` (→ HTTP 504 / gRPC DEADLINE_EXCEEDED)
 instead of rotting in a cohort queue.
 
+In FRONT of admission sits the tier-2 result cache (cache/result.py):
+a repeat request over an unchanged store snapshot returns its memoized
+response without queueing, cohort-waiting, or touching the engine at
+all — singleflight's reuse window (while a twin is in flight) extended
+to the whole mutation epoch.  Gated by ``DGRAPH_TPU_CACHE`` (default
+on; ``0`` restores today's path byte-identically).
+
 Knobs (env): ``DGRAPH_TPU_SCHED`` (gate, default on; ``0`` restores the
 serial per-request path byte-identically), ``DGRAPH_TPU_SCHED_MAX_BATCH``
 (default 32), ``DGRAPH_TPU_SCHED_FLUSH_MS`` (default 2.0),
 ``DGRAPH_TPU_SCHED_QUEUE_CAP`` (default 256),
 ``DGRAPH_TPU_SCHED_MERGE_MS`` (hop-merge window, default 1.0),
-``DGRAPH_TPU_SCHED_CONCURRENCY`` (flush workers, default 2).
+``DGRAPH_TPU_SCHED_CONCURRENCY`` (flush workers, default 2),
+``DGRAPH_TPU_CACHE`` / ``DGRAPH_TPU_CACHE_RESULT_BYTES`` (tier-2 result
+cache gate and byte budget, cache/result.py).
 """
 
 from __future__ import annotations
@@ -96,6 +105,12 @@ class CohortScheduler:
         concurrency: Optional[int] = None,
     ):
         self._server = server
+        # tier-2 result cache (cache/result.py): probed before admission
+        # in run(); None when DGRAPH_TPU_CACHE=0 (or zero budget) — the
+        # admission path is then byte-identical to the pre-cache code
+        from dgraph_tpu.cache import ResultCache, cache_enabled
+
+        self.result_cache = ResultCache() if cache_enabled() else None
         self.max_batch = int(
             max_batch
             if max_batch is not None
@@ -156,9 +171,38 @@ class CohortScheduler:
     ):
         """Admit a read-only parsed request and block until its cohort
         executed.  ``key`` (query text + canonical vars + debug) enables
-        singleflight: equal-key cohort members execute once.  Returns
+        singleflight AND tier-2 result caching: equal-key cohort members
+        execute once, and a repeat of an already-executed key over the
+        same store snapshot skips admission entirely.  Returns
         (response dict, engine stats); raises SchedOverloadError /
         SchedDeadlineError on shed."""
+        # duck-typed stores (ClusterStore) may predate .version; 0 keeps
+        # them schedulable, merely coalescing across mutation boundaries
+        # their own read path already treats as eventually consistent
+        store_ver = getattr(self._server.store, "version", None)
+        sig = hop_signature(parsed, store_ver or 0)
+        # tier-2 probe BEFORE admission: the version in the key is
+        # captured pre-execution (sig[0]), so a racing mutation can only
+        # strand an entry under an old version — never serve stale.  A
+        # store with NO version has no mutation epoch to key under, and
+        # a store whose version is not STRICT (ClusterStore: remote-TTL
+        # reads refresh without a bump, and only during execution) must
+        # never cache — a warm hit would starve its freshness probes.
+        rc_key = None
+        rc = self.result_cache
+        if (
+            rc is not None
+            and key is not None
+            and store_ver is not None
+            and getattr(self._server.store, "strict_snapshot_versions", False)
+        ):
+            from dgraph_tpu.cache import cacheable
+
+            if cacheable(parsed):
+                rc_key = key
+                hit = rc.get(rc_key, sig[0])
+                if hit is not None:
+                    return hit
         # timeout_s None = no budget; <= 0 = budget ALREADY spent (a
         # gRPC deadline that lapsed in transit, X-Dgraph-Timeout: 0) —
         # that sheds immediately rather than silently running unbounded
@@ -168,12 +212,6 @@ class CohortScheduler:
             else None
         )
         req = SchedRequest(parsed, debug=debug, deadline=deadline, key=key)
-        # duck-typed stores (ClusterStore) may predate .version; 0 keeps
-        # them schedulable, merely coalescing across mutation boundaries
-        # their own read path already treats as eventually consistent
-        sig = hop_signature(
-            parsed, getattr(self._server.store, "version", 0)
-        )
         with self._cond:
             if self._stopped:
                 raise SchedOverloadError("scheduler stopped")
@@ -199,7 +237,12 @@ class CohortScheduler:
                 self._last_arrival = time.monotonic()
                 SCHED_QUEUE_DEPTH.set(self._depth)
                 self._cond.notify_all()
-        return req.wait()
+        result, stats = req.wait()
+        if rc_key is not None:
+            # sharing the response dict is safe by the singleflight
+            # argument: handlers only encode results, never mutate them
+            rc.put(rc_key, sig[0], result, stats)
+        return result, stats
 
     # -- flush workers -----------------------------------------------------
 

@@ -471,9 +471,14 @@ class GrpcServer:
                 context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
             if isinstance(e, SchedDeadlineError):
                 context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+            # isinstance, not a name list: every client-input error in
+            # the tree subclasses ValueError (gql.ParseError,
+            # rdf.ParseError, QueryError) — the old exact-name check
+            # returned INTERNAL for a malformed query that raised a
+            # SUBCLASS the list didn't spell out
             code = (
                 grpc.StatusCode.INVALID_ARGUMENT
-                if type(e).__name__ in ("GqlError", "QueryError", "ValueError")
+                if isinstance(e, ValueError)
                 else grpc.StatusCode.INTERNAL
             )
             context.abort(code, str(e))
@@ -534,40 +539,58 @@ class ChannelPool:
     the analog of the reference's worker conn pool (worker/conn.go:108-173
     Pool.Get/release + query.Echo probe, here CheckVersion).  Channels are
     created on first Get(target), shared by refcount, and closed when the
-    last user releases them."""
+    last user releases them.
+
+    ``cafile`` (a pinned CA / server-cert PEM) builds a TLS-verified
+    channel — the client-side mirror of GrpcRaftTransport's pinned-CA
+    path, for servers started with ``--tls_cert`` (their gRPC listener
+    serves TLS too).  Pool entries key on (target, cafile) so a
+    plaintext and a TLS channel to the same host:port never alias."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._chans: Dict[str, Tuple[object, int]] = {}
+        self._chans: Dict[Tuple[str, str], Tuple[object, int]] = {}
 
-    def get(self, target: str):
+    def _make_channel(self, target: str, cafile: str):
         import grpc
 
+        if cafile:
+            with open(cafile, "rb") as f:
+                creds = grpc.ssl_channel_credentials(f.read())
+            return grpc.secure_channel(target, creds)
+        return grpc.insecure_channel(target)
+
+    def get(self, target: str, cafile: Optional[str] = None):
+        key = (target, cafile or "")
         with self._lock:
-            ent = self._chans.get(target)
+            ent = self._chans.get(key)
             if ent is None:
-                ch = grpc.insecure_channel(target)
-                self._chans[target] = (ch, 1)
+                ch = self._make_channel(target, cafile or "")
+                self._chans[key] = (ch, 1)
                 return ch
             ch, rc = ent
-            self._chans[target] = (ch, rc + 1)
+            self._chans[key] = (ch, rc + 1)
             return ch
 
-    def release(self, target: str) -> None:
+    def release(self, target: str, cafile: Optional[str] = None) -> None:
+        key = (target, cafile or "")
         with self._lock:
-            ent = self._chans.get(target)
+            ent = self._chans.get(key)
             if ent is None:
                 return
             ch, rc = ent
             if rc <= 1:
-                del self._chans[target]
+                del self._chans[key]
                 ch.close()
             else:
-                self._chans[target] = (ch, rc - 1)
+                self._chans[key] = (ch, rc - 1)
 
-    def probe(self, target: str, timeout: float = 2.0) -> bool:
+    def probe(
+        self, target: str, timeout: float = 2.0,
+        cafile: Optional[str] = None,
+    ) -> bool:
         """CheckVersion round-trip (conn.go's Echo/Ping analog)."""
-        ch = self.get(target)
+        ch = self.get(target, cafile)
         try:
             fn = ch.unary_unary("/protos.Dgraph/CheckVersion")
             tag = decode_version(fn(b"", timeout=timeout))
@@ -575,4 +598,4 @@ class ChannelPool:
         except Exception:
             return False
         finally:
-            self.release(target)
+            self.release(target, cafile)

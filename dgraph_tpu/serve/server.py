@@ -228,7 +228,11 @@ class DgraphServer:
                 # read-only: ride a cohort (the scheduler's member thread
                 # sets DEBUG_UIDS for the encode; writes and profiled
                 # runs keep the exclusive path below, untouched).  The
-                # key makes equal requests singleflight-coalescible.
+                # key makes equal requests singleflight-coalescible AND
+                # tier-2 result-cacheable: a repeat of an executed key
+                # over the same store snapshot returns from the cache
+                # before admission (sched/scheduler.py, cache/result.py;
+                # DGRAPH_TPU_CACHE=0 restores today's path exactly).
                 vkey = (
                     json.dumps(variables, sort_keys=True) if variables else ""
                 )
@@ -393,6 +397,7 @@ def _make_handler(srv: DgraphServer):
             elif path == "/debug/store":
                 with srv._engine_lock.read():
                     stats = _store_stats(srv.store)
+                stats["qcache"] = _qcache_stats(srv)
                 self._reply(200, json.dumps(stats).encode())
             elif path == "/debug/prometheus_metrics":
                 self._reply(200, metrics.prometheus_text().encode(), "text/plain")
@@ -602,6 +607,26 @@ def _make_handler(srv: DgraphServer):
                 self._err(404, "no such endpoint")
 
     return Handler
+
+
+def _qcache_stats(srv: DgraphServer) -> dict:
+    """Two-tier query cache occupancy for /debug/store (the counters
+    live on /debug/prometheus_metrics; this is the at-a-glance view).
+    Both tiers are None under DGRAPH_TPU_CACHE=0."""
+    hop = srv.engine.arenas.hop_cache
+    rc = srv.scheduler.result_cache if srv.scheduler is not None else None
+    return {
+        "hop": (
+            {"entries": len(hop), "bytes": hop.occupancy_bytes}
+            if hop is not None
+            else None
+        ),
+        "result": (
+            {"entries": len(rc), "bytes": rc.occupancy_bytes}
+            if rc is not None
+            else None
+        ),
+    }
 
 
 def _store_stats(store: PostingStore) -> dict:

@@ -228,3 +228,21 @@ SCHED_SHED = metrics.labeled("dgraph_sched_shed_total", label="reason")
 SCHED_MERGED_HOPS = metrics.counter("dgraph_sched_merged_hops_total")
 SCHED_COALESCED = metrics.counter("dgraph_sched_coalesced_requests_total")
 SCHED_QUEUE_DEPTH = metrics.gauge("dgraph_sched_queue_depth")
+
+# two-tier query cache surface (dgraph_tpu/cache/): per-tier event
+# counters (hit / miss / stale / evicted / rejected), occupancy-bytes
+# gauges, and the shared hit-age histogram — hit age tells an operator
+# directly how long results live between mutations (a warm cache with
+# young hits = churny store; old hits = the zipf head paying off)
+QCACHE_HOP_EVENTS = metrics.labeled(
+    "dgraph_qcache_hop_events_total", label="event"
+)
+QCACHE_RESULT_EVENTS = metrics.labeled(
+    "dgraph_qcache_result_events_total", label="event"
+)
+QCACHE_HOP_BYTES = metrics.gauge("dgraph_qcache_hop_bytes")
+QCACHE_RESULT_BYTES = metrics.gauge("dgraph_qcache_result_bytes")
+QCACHE_HIT_AGE = metrics.histogram(
+    "dgraph_qcache_hit_age_seconds",
+    (0.001, 0.01, 0.1, 1.0, 10.0, 60.0, 600.0),
+)

@@ -1,0 +1,448 @@
+"""Two-tier snapshot-versioned query cache (dgraph_tpu/cache/):
+correctness across mutations, arena evictions and concurrency, the
+LFU-with-aging admission policy, parity with the cache-off path, and
+the Prometheus exposition of the new series.
+
+The load-bearing invariant everywhere: a mutation bumps
+``store.version`` and NO cached entry recorded under an older version
+is ever served — stale entries die logically at the bump and are
+reclaimed by the incremental sweep, never handed out.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.cache import (
+    HopCache,
+    ResultCache,
+    VersionedLFUCache,
+    cacheable,
+)
+from dgraph_tpu.models import PostingStore
+from dgraph_tpu.query.engine import QueryEngine
+from dgraph_tpu.serve.server import DgraphServer
+from dgraph_tpu.utils.metrics import (
+    QCACHE_HOP_EVENTS,
+    QCACHE_RESULT_EVENTS,
+)
+
+
+def _parse(text):
+    from dgraph_tpu import gql
+
+    return gql.parse(text, None)
+
+
+def _post(addr, body, timeout=30):
+    req = urllib.request.Request(
+        addr + "/query", data=body.encode(), method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _seed_store():
+    store = PostingStore()
+    store.apply_schema("name: string @index(exact) .\nfriend: uid @reverse .")
+    store.set_value("name", 1, _tv("Ann"))
+    store.set_value("name", 2, _tv("Ben"))
+    store.set_value("name", 3, _tv("Cara"))
+    store.set_edge("friend", 1, 2)
+    store.set_edge("friend", 1, 3)
+    store.set_edge("friend", 2, 3)
+    return store
+
+
+def _tv(s):
+    from dgraph_tpu.models.types import TypeID, TypedValue
+
+    return TypedValue(TypeID.STRING, s)
+
+
+# ------------------------------------------------------------- core policy
+
+
+def test_core_hit_miss_stale():
+    c = VersionedLFUCache(budget_bytes=1 << 20)
+    assert c.get("k", 1) is None                      # miss
+    assert c.put("k", 1, "v", 100)
+    assert c.get("k", 1)[0] == "v"                    # live hit
+    assert c.get("k", 2) is None                      # older version = stale
+    assert c.get("k", 2) is None                      # reclaimed, plain miss
+    assert len(c) == 0 and c.occupancy_bytes == 0
+
+
+def test_core_megaquery_refused_admission():
+    """One giant entry can't evict the hot head: entries over the
+    per-entry cap are refused outright."""
+    c = VersionedLFUCache(budget_bytes=1000, max_entry_frac=0.125)
+    assert c.put("hot", 1, "v", 100)
+    assert not c.put("mega", 1, "V", 500)             # > 125-byte cap
+    assert c.get("hot", 1) is not None                # untouched
+    assert c.get("mega", 1) is None
+
+
+def test_core_lfu_evicts_cold_not_hot():
+    c = VersionedLFUCache(budget_bytes=1000, max_entry_frac=0.5)
+    c.put("hot", 1, "v", 400)
+    for _ in range(5):
+        assert c.get("hot", 1) is not None            # heat it up
+    c.put("cold", 1, "v", 400)
+    c.put("new", 1, "v", 400)                         # over budget: evict one
+    assert c.get("hot", 1) is not None                # LFU kept the hot key
+    assert c.get("cold", 1) is None                   # coldest evicted
+
+
+def test_core_generation_sweep_reclaims_stale_bytes():
+    """Dead-version entries are reclaimed incrementally by puts — no
+    global flush, but the budget comes back."""
+    c = VersionedLFUCache(budget_bytes=1 << 20, sweep_limit=64)
+    for i in range(50):
+        c.put(("old", i), 1, "v", 100)
+    assert len(c) == 50
+    # a new-version put sweeps the dead generation (all 50 fit inside
+    # one sweep_limit=64 batch), so only the live entries remain
+    c.put("fresh", 2, "v", 100)
+    c.put("fresh2", 2, "v", 100)
+    assert len(c) == 2
+    assert c.occupancy_bytes == 200
+
+
+def test_core_aging_lets_new_heat_win():
+    """Frequencies halve every age_interval puts, so yesterday's hot key
+    cannot squat forever against a currently-hot one."""
+    c = VersionedLFUCache(
+        budget_bytes=800, max_entry_frac=0.5, age_interval=4
+    )
+    c.put("old", 1, "v", 400)
+    for _ in range(64):
+        c.get("old", 1)                               # huge historic heat
+    # aging decay across puts, while the new key keeps getting touched
+    for i in range(12):
+        c.put("new", 1, "v", 400)                     # re-puts keep it warm
+        c.get("new", 1)
+    c.get("new", 1)
+    c.put("now", 1, "v", 400)                         # forces an eviction
+    assert c.get("new", 1) is not None or c.get("now", 1) is not None
+    # the historically-hot-but-idle key is the one that lost its slot
+    assert c.get("old", 1) is None
+
+
+# ------------------------------------------------------------ tier 1 (hop)
+
+
+def test_hop_cache_hits_and_mutation_invalidation():
+    """Repeat expansions hit; a mutation bumps the version and the next
+    read recomputes against fresh arenas — never a stale expansion."""
+    store = _seed_store()
+    eng = QueryEngine(store)
+    assert eng.arenas.hop_cache is not None
+    q = "{ q(func: uid(0x1)) { friend { name } } }"
+    before = QCACHE_HOP_EVENTS.snapshot()
+    out1 = eng.run(q)
+    out2 = eng.run(q)
+    after = QCACHE_HOP_EVENTS.snapshot()
+    assert out1 == out2
+    assert after.get("hit", 0) - before.get("hit", 0) >= 1
+    # mutation-then-read: fresh data, not the memoized expansion
+    store.set_edge("friend", 1, 4)
+    store.set_value("name", 4, _tv("Dee"))
+    out3 = eng.run(q)
+    names = sorted(f["name"] for f in out3["q"][0]["friend"])
+    assert names == ["Ben", "Cara", "Dee"]
+
+
+def test_hop_cache_dropped_on_arena_eviction():
+    """Evicting an arena under the HBM budget drops its tier-1 entries
+    (id-keyed entries must never outlive the arena object)."""
+    store = _seed_store()
+    eng = QueryEngine(store, arena_budget_bytes=1)  # evict on every build
+    hc = eng.arenas.hop_cache
+    arena = eng.arenas.data("friend")
+    eng.expander.expand(arena, np.array([1, 2]), attr="friend")
+    assert len(hc) == 1
+    # building ANOTHER arena under the 1-byte budget evicts 'friend'
+    eng.arenas.reverse("friend")
+    assert len(hc) == 0
+
+
+def test_hop_cache_disabled_by_gate(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_CACHE", "0")
+    eng = QueryEngine(_seed_store())
+    assert eng.arenas.hop_cache is None
+    out = eng.run("{ q(func: uid(0x1)) { friend { name } } }")
+    assert sorted(f["name"] for f in out["q"][0]["friend"]) == ["Ben", "Cara"]
+
+
+def test_hop_cache_distinguishes_frontier_order():
+    """Expansion output depends on row order — permuted frontiers must
+    not collide on one entry."""
+    store = _seed_store()
+    eng = QueryEngine(store)
+    arena = eng.arenas.data("friend")
+    a = eng.expander.expand(arena, np.array([1, 2]), attr="friend")
+    b = eng.expander.expand(arena, np.array([2, 1]), attr="friend")
+    assert not np.array_equal(a[0], b[0])
+    # each is its own entry; repeats of each hit exactly
+    a2 = eng.expander.expand(arena, np.array([1, 2]), attr="friend")
+    assert np.array_equal(a[0], a2[0]) and np.array_equal(a[1], a2[1])
+
+
+# --------------------------------------------------------- tier 2 (result)
+
+
+@pytest.fixture()
+def srv():
+    server = DgraphServer(_seed_store())
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_result_cache_hit_skips_execution(srv, monkeypatch):
+    """A repeat request over an unchanged snapshot returns from tier 2
+    without touching the engine at all."""
+    runs = []
+    orig = QueryEngine.run_parsed
+
+    def counting(self, parsed):
+        runs.append(1)
+        return orig(self, parsed)
+
+    monkeypatch.setattr(QueryEngine, "run_parsed", counting)
+    q = "{ q(func: uid(0x1)) { name friend { name } } }"
+    out1 = _post(srv.addr, q)
+    n1 = len(runs)
+    out2 = _post(srv.addr, q)
+    out1.pop("server_latency"), out2.pop("server_latency")
+    assert out1 == out2
+    assert len(runs) == n1  # second request executed NOTHING
+
+
+def test_result_cache_mutation_then_read_is_fresh(srv):
+    q = "{ q(func: uid(0x1)) { friend { name } } }"
+    out1 = _post(srv.addr, q)
+    _post(srv.addr, q)  # warm hit
+    _post(
+        srv.addr,
+        'mutation { set { <0x1> <friend> <0x4> . <0x4> <name> "Dee" . } }',
+    )
+    out2 = _post(srv.addr, q)
+    names = sorted(f["name"] for f in out2["q"][0]["friend"])
+    assert names == ["Ben", "Cara", "Dee"]
+    assert out1 != out2
+
+
+def test_result_cache_keys_on_variables_and_debug(srv):
+    """vars and the debug flag are part of the request key — a cached
+    plain response must not answer a ?debug=true request or different
+    variable bindings."""
+    q = (
+        "query q($n: string) "
+        '{ q(func: eq(name, $n)) { name friend { name } } }'
+    )
+
+    def run(vars_, debug=False):
+        req = urllib.request.Request(
+            srv.addr + "/query" + ("?debug=true" if debug else ""),
+            data=q.encode(), method="POST",
+            headers={"X-Dgraph-Vars": json.dumps(vars_)},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read().decode())
+
+    ann = run({"$n": "Ann"})
+    ann2 = run({"$n": "Ann"})
+    ben = run({"$n": "Ben"})
+    assert ann["q"][0]["name"] == "Ann" == ann2["q"][0]["name"]
+    assert ben["q"][0]["name"] == "Ben"
+    dbg = run({"$n": "Ann"}, debug=True)
+    assert "_uid_" in dbg["q"][0]  # debug encoding, not the cached plain one
+
+
+def test_cacheable_excludes_wall_clock_math():
+    ok = _parse("{ q(func: uid(0x1)) { name } }")
+    assert cacheable(ok)
+    clock = _parse(
+        "{ q(func: uid(0x1)) { d as dob x: math(since(d)) } }"
+    )
+    assert not cacheable(clock)
+
+
+def test_result_cache_gate_off_is_cacheless(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_CACHE", "0")
+    server = DgraphServer(_seed_store())
+    server.start()
+    try:
+        assert server.scheduler is not None
+        assert server.scheduler.result_cache is None
+        assert server.engine.arenas.hop_cache is None
+        q = "{ q(func: uid(0x1)) { name } }"
+        before = QCACHE_RESULT_EVENTS.snapshot()
+        _post(server.addr, q)
+        _post(server.addr, q)
+        assert QCACHE_RESULT_EVENTS.snapshot() == before  # zero cache traffic
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------- concurrency correctness
+
+
+def test_no_stale_hit_across_version_bump(srv):
+    """Concurrent readers racing a stream of mutations: per reader, the
+    observed value index must be MONOTONIC — a cached response from an
+    older snapshot served after the bump would show up as a regression."""
+    q = "{ q(func: uid(0x1)) { name } }"
+    n_writes = 12
+    stop = threading.Event()
+    regressions = []
+    errors = []
+
+    def reader():
+        last = -1
+        try:
+            while not stop.is_set():
+                out = _post(srv.addr, q)
+                name = out["q"][0]["name"]
+                k = 0 if name == "Ann" else int(name[1:])
+                if k < last:
+                    regressions.append((last, k))
+                    return
+                last = k
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    readers = [threading.Thread(target=reader) for _ in range(4)]
+    for t in readers:
+        t.start()
+    try:
+        for k in range(1, n_writes + 1):
+            _post(srv.addr, 'mutation { set { <0x1> <name> "v%d" . } }' % k)
+    finally:
+        stop.set()
+    for t in readers:
+        t.join(timeout=30)
+    assert not errors, errors[:2]
+    assert not regressions, regressions
+    # and the final read is the final write, not any cached ancestor
+    assert _post(srv.addr, q)["q"][0]["name"] == "v%d" % n_writes
+
+
+def test_cache_on_off_parity_under_8_threads(monkeypatch):
+    """The 8-thread parity harness (tests/test_sched.py): responses with
+    the cache on are byte-identical to a DGRAPH_TPU_CACHE=0 server over
+    an identical store."""
+    workload = [
+        "{ q(func: uid(0x1)) { name friend { name } } }",
+        "{ q(func: uid(0x2)) { name friend { name } } }",
+        '{ q(func: eq(name, "Ann")) { name friend { name } } }',
+        "{ q(func: uid(0x1)) { c: count(friend) } }",
+        "{ q(func: uid(0x3)) { name ~friend { name } } }",
+    ]
+    monkeypatch.setenv("DGRAPH_TPU_CACHE", "0")
+    plain = DgraphServer(_seed_store())
+    plain.start()
+    try:
+        want = {}
+        for q in workload:
+            out = _post(plain.addr, q)
+            out.pop("server_latency", None)
+            want[q] = out
+    finally:
+        plain.stop()
+
+    monkeypatch.setenv("DGRAPH_TPU_CACHE", "1")
+    cached = DgraphServer(_seed_store())
+    cached.start()
+    results, errs = [], []
+    try:
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            try:
+                for _ in range(8):
+                    q = workload[int(rng.integers(len(workload)))]
+                    out = _post(cached.addr, q)
+                    out.pop("server_latency", None)
+                    results.append((q, out))
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=client, args=(s,)) for s in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+    finally:
+        cached.stop()
+    assert not errs, errs[:3]
+    assert len(results) == 64
+    for q, out in results:
+        assert out == want[q], q
+
+
+# ------------------------------------------------------- metrics / tooling
+
+
+def test_qcache_prometheus_series_render(srv):
+    """CI guard: the new per-tier series render in the /debug metrics
+    exposition after real traffic."""
+    q = "{ q(func: uid(0x1)) { friend { name } } }"
+    _post(srv.addr, q)
+    _post(srv.addr, q)  # guarantees at least one tier-2 hit (hit-age too)
+    with urllib.request.urlopen(
+        srv.addr + "/debug/prometheus_metrics", timeout=10
+    ) as r:
+        text = r.read().decode()
+    assert 'dgraph_qcache_result_events_total{event="hit"}' in text
+    assert 'dgraph_qcache_result_events_total{event="miss"}' in text
+    assert "dgraph_qcache_hop_events_total" in text
+    assert "dgraph_qcache_hop_bytes" in text
+    assert "dgraph_qcache_result_bytes" in text
+    assert "dgraph_qcache_hit_age_seconds_bucket" in text
+    # occupancy also shows at-a-glance on /debug/store
+    with urllib.request.urlopen(srv.addr + "/debug/store", timeout=10) as r:
+        st = json.loads(r.read().decode())
+    assert st["qcache"]["result"]["entries"] >= 1
+
+
+def test_hop_cache_drop_arena_is_selective():
+    hc = HopCache(budget_bytes=1 << 20)
+    a1, a2 = object(), object()
+    src = np.array([1, 2, 3], dtype=np.int64)
+    out = np.array([7], dtype=np.int64)
+    seg = np.array([0, 1, 1, 1], dtype=np.int64)
+    hc.put(a1, "p", False, src, 5, out, seg)
+    hc.put(a2, "p", False, src, 5, out, seg)
+    assert len(hc) == 2
+    assert hc.drop_arena(id(a1)) == 1
+    assert len(hc) == 1
+    assert hc.get(a2, "p", False, src, 5) is not None
+    assert hc.get(a1, "p", False, src, 5) is None
+
+
+def test_result_cache_zero_budget_disables():
+    rc = ResultCache(budget_bytes=0)
+    rc.put(("q", "", False), 1, {"q": []}, {})
+    assert rc.get(("q", "", False), 1) is None
+
+
+def test_tier2_never_caches_non_strict_version_stores(srv, monkeypatch):
+    """Stores whose version is not strict (ClusterStore: remote-TTL
+    reads refresh WITHOUT a bump, and only during execution) must never
+    tier-2 cache — a warm hit would starve the freshness probe and
+    serve the stale remote copy forever (the test_placement regression
+    this guard exists for)."""
+    monkeypatch.setattr(
+        type(srv.store), "strict_snapshot_versions", False, raising=False
+    )
+    q = "{ q(func: uid(0x2)) { name } }"
+    before = QCACHE_RESULT_EVENTS.snapshot()
+    _post(srv.addr, q)
+    _post(srv.addr, q)
+    after = QCACHE_RESULT_EVENTS.snapshot()
+    assert after == before  # zero tier-2 traffic, every request executes

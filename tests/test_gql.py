@@ -549,3 +549,25 @@ def test_pagination_int_args_base10():
     assert res.queries[0].args["first"] == "010"  # decodes as 10 downstream
     with pytest.raises(ParseError):
         parse("{ me(func: uid(1), first: 0x10) { name } }")
+
+
+def test_mutation_finder_string_token_is_line_bounded():
+    """ISSUE 3 satellite: _MUT_TOK_RE's string-literal token must be
+    line-bounded like _LINE_TOK_RE's, so the two tokenizers agree about
+    brace nesting on inputs with an unterminated quote — a multi-line
+    string token would hide a genuine top-level `mutation {` (and the
+    braces _match_brace still counts)."""
+    from dgraph_tpu.gql.parser import _find_toplevel_mutation, _match_brace
+
+    text = '<0x1> <p> "unterminated \nmutation { set { <0x1> <name> "B" . } }'
+    m = _find_toplevel_mutation(text)
+    assert m is not None, "unterminated quote hid the top-level mutation"
+    assert text[m.brace] == "{"
+    assert _match_brace(text, m.brace) == len(text) - 1  # tokenizers agree
+    # a quoted 'mutation' on ONE line is still just a string
+    assert _find_toplevel_mutation(
+        '{ q(func: eq(name, "mutation { }")) { name } }'
+    ) is None
+    # and escaped quotes still don't terminate the literal
+    res = parse('mutation { set { <0x1> <name> "a\\"b" . } }')
+    assert res.mutation is not None and '"a\\"b"' in res.mutation.set_nquads

@@ -570,7 +570,68 @@ def test_channel_pool_refcount_and_probe(servers):
     assert a is b  # shared by refcount
     assert pool.probe(target)
     pool.release(target)
-    assert target in pool._chans  # still referenced once
+    assert (target, "") in pool._chans  # still referenced once
     pool.release(target)
-    assert target not in pool._chans  # last release closes
+    assert (target, "") not in pool._chans  # last release closes
     assert not pool.probe("127.0.0.1:1")  # dead target: probe says so
+
+
+def test_channel_pool_tls_entries_never_alias_plaintext(tmp_path):
+    """A cafile'd (TLS) channel and a plaintext channel to the same
+    host:port are distinct pool entries — no aliasing, independent
+    refcounts (the --tls_cert client-side satellite)."""
+    ca = tmp_path / "ca.pem"
+    # self-signed cert PEM is only parsed at channel construction; any
+    # syntactically-valid cert works for pool-identity testing
+    import subprocess
+
+    key = tmp_path / "k.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(ca), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    pool = ChannelPool()
+    plain = pool.get("127.0.0.1:1")
+    tls = pool.get("127.0.0.1:1", cafile=str(ca))
+    assert plain is not tls
+    assert ("127.0.0.1:1", "") in pool._chans
+    assert ("127.0.0.1:1", str(ca)) in pool._chans
+    pool.release("127.0.0.1:1")
+    assert ("127.0.0.1:1", "") not in pool._chans
+    assert ("127.0.0.1:1", str(ca)) in pool._chans  # untouched
+    pool.release("127.0.0.1:1", cafile=str(ca))
+    assert not pool._chans
+
+
+def test_grpc_transport_https_requires_cafile():
+    """An https-derived target without a pinned CA must fail LOUDLY at
+    construction — dialing plaintext into a --tls_cert server fails
+    every RPC with an opaque UNAVAILABLE instead (the old behavior)."""
+    with pytest.raises(ValueError, match="cafile"):
+        GrpcTransport("https://127.0.0.1:8080")
+
+
+def test_grpc_transport_maps_http_scheme_target(servers):
+    """http://host:port transports map to the +1000 gRPC convention —
+    the loader's address form works directly now."""
+    srv, gsrv = servers
+    t2 = GrpcTransport(f"http://127.0.0.1:{gsrv.port - 1000}")
+    try:
+        assert t2.target == f"127.0.0.1:{gsrv.port}"
+        assert t2.check_version().startswith("0.7")
+    finally:
+        t2.close()
+
+
+def test_parse_error_maps_to_invalid_argument(chan):
+    """gql/rdf ParseError subclass ValueError: the Run error mapping
+    must return INVALID_ARGUMENT for malformed input, not INTERNAL."""
+    for bad in (
+        "{ q(func: uid(0x1)) { name }",          # unbalanced braces
+        'mutation { set { <0x1> name "A" . } }',  # bad RDF predicate term
+    ):
+        with pytest.raises(grpc.RpcError) as ei:
+            _run(chan, _str_field(1, bad))
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT, bad
