@@ -1,0 +1,31 @@
+"""graftcheck — repo-native static analysis + runtime invariants.
+
+The engine's performance story rests on invariants no unit test states:
+bounded compile counts on the hop hot path, no host↔device syncs inside
+traced bodies, no lock-order inversions between scheduler / cache /
+arena / cluster threads, monotonic clocks for every duration.  Go-side
+Dgraph leans on ``go vet`` and the race detector for this class of bug;
+this package is the Python/JAX equivalent, grown for THIS repo's idioms
+rather than generic style:
+
+- :mod:`.framework` — AST rule runner, pragma + baseline suppression;
+- :mod:`.rules` — the lint rules (host-sync-in-jit, recompile-hazard,
+  wallclock-duration, swallowed-exception);
+- :mod:`.lockorder` — static ``with <lock>`` nesting graph over the
+  package, cycle detection;
+- :mod:`.witness` — runtime lock-order witness recorder (lockdep-style),
+  armed during tests by ``tests/conftest.py``;
+- :mod:`.pytest_budget` — pytest hooks enforcing per-test JAX compile
+  budgets (``analysis/budgets.json``) and ``jax.transfer_guard`` markers.
+
+CLI: ``python -m dgraph_tpu.analysis`` (see ``--help``; exits nonzero on
+any non-baselined finding or lock-order cycle).  Docs: docs/analysis.md.
+"""
+
+from dgraph_tpu.analysis.framework import (  # noqa: F401
+    Finding,
+    Rule,
+    load_baseline,
+    run_rules,
+)
+from dgraph_tpu.analysis.rules import ALL_RULES  # noqa: F401

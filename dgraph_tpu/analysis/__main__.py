@@ -1,0 +1,111 @@
+"""CLI: ``python -m dgraph_tpu.analysis [paths...]``.
+
+Runs graftlint (AST rules) and the static lock-order pass over the
+package (default: the installed ``dgraph_tpu`` tree) and exits nonzero
+on any non-baselined finding, lock-order cycle, or self-nesting on a
+non-reentrant lock.  CI runs this with the shipped (empty) baseline;
+``--write-baseline`` exists for adopting the suite on a tree with
+standing debt, not for silencing new findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from dgraph_tpu.analysis.framework import (
+    apply_baseline,
+    load_baseline,
+    run_rules,
+    write_baseline,
+)
+from dgraph_tpu.analysis.lockorder import check_lock_order
+from dgraph_tpu.analysis.rules import ALL_RULES
+
+_DEFAULT_EXCLUDE = ("dgraph_tpu/analysis/",)  # the checker's own fixtures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dgraph_tpu.analysis",
+        description="graftcheck: repo-native static analysis "
+                    "(rule catalog: docs/analysis.md)",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to check (default: the dgraph_tpu package)",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help="JSON baseline of accepted finding fingerprints",
+    )
+    ap.add_argument(
+        "--write-baseline", metavar="PATH", default=None,
+        help="write current findings as the new baseline and exit 0",
+    )
+    ap.add_argument(
+        "--lock-graph", action="store_true",
+        help="print the full static lock-order graph",
+    )
+    ap.add_argument(
+        "--no-lint", action="store_true", help="skip the AST rules"
+    )
+    ap.add_argument(
+        "--no-locks", action="store_true", help="skip the lock-order pass"
+    )
+    ns = ap.parse_args(argv)
+
+    pkg_root = Path(__file__).resolve().parents[1]   # dgraph_tpu/
+    repo_root = pkg_root.parent
+    roots = ns.paths or [str(pkg_root)]
+
+    rc = 0
+    if not ns.no_lint:
+        findings = run_rules(
+            roots, ALL_RULES, repo_root=str(repo_root),
+            exclude=_DEFAULT_EXCLUDE,
+        )
+        if ns.write_baseline:
+            write_baseline(ns.write_baseline, findings)
+            print(
+                f"wrote {len(findings)} fingerprint(s) to {ns.write_baseline}"
+            )
+            return 0
+        fresh = apply_baseline(findings, load_baseline(ns.baseline))
+        for f in fresh:
+            print(f.render())
+        n_base = len(findings) - len(fresh)
+        if fresh:
+            print(
+                f"\ngraftlint: {len(fresh)} finding(s)"
+                + (f" ({n_base} baselined)" if n_base else "")
+            )
+            rc = 1
+        else:
+            print(
+                "graftlint: clean"
+                + (f" ({n_base} baselined)" if n_base else "")
+            )
+
+    if not ns.no_locks:
+        graph, problems = check_lock_order(
+            roots, repo_root=str(repo_root), exclude=_DEFAULT_EXCLUDE
+        )
+        if ns.lock_graph:
+            print(graph.render())
+        for p in problems:
+            print(p)
+        if problems:
+            rc = 1
+        else:
+            print(
+                f"lock-order: cycle-free "
+                f"({len(graph.classes)} lock classes, "
+                f"{len(graph.edges)} edges)"
+            )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

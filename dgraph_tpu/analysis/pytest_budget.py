@@ -1,0 +1,171 @@
+"""Pytest hooks enforcing JAX compile-count budgets and transfer guards.
+
+"The second same-shape cohort adds zero programs" used to be one
+hand-written assert in tests/test_sched.py; everything else about the
+engine's compile story — bounded program families in ops/batch.py, the
+hop cache short-circuiting dispatch, module-level jit caching in
+query/engine.py — was hope.  These hooks make it a repo-wide gate:
+
+- every backend compile is counted via ``jax.monitoring``'s
+  ``/jax/core/compile/backend_compile_duration`` event (one event per
+  XLA compilation, cache hits excluded);
+- each test's compile delta is checked against a budget resolved as
+  ``@pytest.mark.compile_budget(n)`` > ``overrides[nodeid]`` >
+  ``overrides[file]`` > ``default`` from ``analysis/budgets.json``
+  (``null`` = unlimited).  Budget busts fail the test with the delta in
+  the message;
+- ``@pytest.mark.transfer_guard`` (optionally ``("log")`` etc.) wraps
+  the test body in ``jax.transfer_guard(level)`` — used by the
+  hop-dispatch invariant tests to prove the compiled hop programs
+  perform zero implicit host↔device transfers when handed
+  device-resident arguments;
+- ``DGRAPH_TPU_COMPILE_BUDGET_REPORT=1`` prints the top compile
+  consumers at session end (how budgets in budgets.json were tuned;
+  see docs/analysis.md).
+
+Wired into tier-1 by ``tests/conftest.py`` importing these hook
+functions into its module namespace.  Compiles triggered by engine
+worker threads land in whichever test is running when the compile
+finishes — budgets are therefore per-test *attribution*, not a strict
+causal account; the default budget carries headroom for that (and for
+jax-internal helper programs like ``jnp.ones``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from contextlib import nullcontext
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import pytest
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_compiles = 0
+_installed = False
+_budgets: Optional[dict] = None
+_per_test: List[Tuple[str, int]] = []
+
+
+class CompileBudgetExceeded(AssertionError):
+    """A test compiled more XLA programs than its budget allows."""
+
+
+def _on_event_duration(name: str, secs: float, **kw) -> None:
+    global _compiles
+    if name == _COMPILE_EVENT:
+        with _lock:
+            _compiles += 1
+
+
+def install_compile_counter() -> None:
+    """Register the jax.monitoring listener (idempotent)."""
+    global _installed
+    if _installed:
+        return
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+    _installed = True
+
+
+def compile_count() -> int:
+    return _compiles
+
+
+def load_budgets() -> dict:
+    global _budgets
+    if _budgets is None:
+        p = Path(__file__).with_name("budgets.json")
+        _budgets = json.loads(p.read_text()) if p.exists() else {}
+    return _budgets
+
+
+def budget_for(item) -> Optional[int]:
+    """Marker > nodeid override > file override > default; None/null =
+    unlimited."""
+    m = item.get_closest_marker("compile_budget")
+    if m is not None and m.args:
+        return int(m.args[0]) if m.args[0] is not None else None
+    b = load_budgets()
+    overrides: Dict[str, object] = b.get("overrides", {})
+    nodeid = item.nodeid
+    if nodeid in overrides:
+        v = overrides[nodeid]
+        return None if v is None else int(v)
+    fname = nodeid.split("::", 1)[0]
+    if fname in overrides:
+        v = overrides[fname]
+        return None if v is None else int(v)
+    v = b.get("default")
+    return None if v is None else int(v)
+
+
+# -- pytest hooks (imported by tests/conftest.py) ---------------------------
+
+def budget_plugin_configure(config) -> None:
+    config.addinivalue_line(
+        "markers",
+        "compile_budget(n): cap the number of XLA compilations this test "
+        "may trigger (analysis/budgets.json sets the default)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "transfer_guard(level='disallow'): run the test body under "
+        "jax.transfer_guard(level)",
+    )
+    install_compile_counter()
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    guard = item.get_closest_marker("transfer_guard")
+    cm = nullcontext()
+    if guard is not None:
+        import jax
+
+        level = guard.args[0] if guard.args else "disallow"
+        cm = jax.transfer_guard(level)
+    before = compile_count()
+    try:
+        with cm:
+            result = yield
+    finally:
+        # record the delta even when the test body raised: a test that
+        # both flakes AND busts its budget must still show up in the
+        # DGRAPH_TPU_COMPILE_BUDGET_REPORT accounting
+        used = compile_count() - before
+        if used:
+            _per_test.append((item.nodeid, used))
+    # the budget check itself only fires on tests that passed — raising
+    # here on an already-failing test would mask its real error
+    budget = budget_for(item)
+    if budget is not None and used > budget:
+        raise CompileBudgetExceeded(
+            f"{item.nodeid} triggered {used} XLA compilations, over its "
+            f"budget of {budget}.  If the growth is intentional (new "
+            "program family, new shape class), raise the budget in "
+            "dgraph_tpu/analysis/budgets.json with a comment; if not, "
+            "you likely built a jit inside a loop or broke a program "
+            "cache key — see docs/analysis.md#compile-budgets"
+        )
+    return result
+
+
+def budget_plugin_report(terminalreporter=None) -> List[Tuple[str, int]]:
+    """Top compile consumers; printed when
+    DGRAPH_TPU_COMPILE_BUDGET_REPORT=1."""
+    top = sorted(_per_test, key=lambda x: -x[1])[:25]
+    if os.environ.get("DGRAPH_TPU_COMPILE_BUDGET_REPORT") == "1":
+        write = (
+            terminalreporter.write_line if terminalreporter is not None
+            else print
+        )
+        write(f"compile-budget: {_compiles} total XLA compilations")
+        for nodeid, n in top:
+            write(f"  {n:5d}  {nodeid}")
+    return top

@@ -1,0 +1,488 @@
+"""The graftlint rule set — this repo's idioms, not generic style.
+
+Each rule targets a bug class that has actually bitten (or nearly
+bitten) this codebase and that the tier-1 suite cannot catch reliably
+on a noisy 2-core CPU host:
+
+- ``host-sync-in-jit``: a stray ``.item()`` / ``bool(tracer)`` /
+  ``np.asarray`` inside a ``jit``/``scan``/``pallas_call`` body either
+  fails at trace time in a rarely-hit branch or — worse — silently
+  forces a device→host sync per call and ruins the one-dispatch-per-hop
+  story (ops/batch.py).
+- ``recompile-hazard``: ``jax.jit`` constructed inside a loop or
+  invoked inline (``jax.jit(f)(x)``) defeats jit's weakref cache and
+  recompiles per iteration/call; the budgets in
+  ``analysis/budgets.json`` would catch the symptom at test time, this
+  catches the cause at review time.
+- ``wallclock-duration``: interval math on ``time.time()`` breaks under
+  NTP slew/step — scheduler deadlines, raft election ticks and cache
+  aging must use ``time.monotonic()``.  Wall clock stays legitimate
+  where a *user-visible timestamp* is involved (``since()`` compares
+  against stored dates; pragma those sites).
+- ``swallowed-exception``: a broad ``except Exception: pass`` in
+  cluster/raft/loader code turns partial outages into silent data
+  gaps; narrow the type or count it via
+  ``utils.metrics.note_swallowed`` so operators can see the drop rate.
+
+Suppress a deliberate site with ``# graftlint: ignore[rule-id]`` on the
+line (or the line above).  docs/analysis.md has the full catalog and
+the how-to-add-a-rule walkthrough.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from dgraph_tpu.analysis.framework import FileContext, Finding, Rule
+
+
+# -- shared AST helpers -----------------------------------------------------
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.lax.scan' for nested Attribute/Name chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_expr(node: ast.AST, jit_names: Set[str]) -> bool:
+    """``jax.jit`` / imported ``jit`` / ``partial(jax.jit, ...)``."""
+    d = _dotted(node)
+    if d in jit_names:
+        return True
+    if isinstance(node, ast.Call):
+        f = _dotted(node.func)
+        if f in ("partial", "functools.partial") and node.args:
+            return _is_jit_expr(node.args[0], jit_names)
+        return f in jit_names  # jax.jit(fn) / jax.jit(fn, static_...)
+    return False
+
+
+def _jit_call_of(node: ast.AST, jit_names: Set[str]) -> Optional[ast.Call]:
+    """The Call node carrying static_arg* keywords, if any."""
+    if isinstance(node, ast.Call):
+        f = _dotted(node.func)
+        if f in jit_names:
+            return node
+        if f in ("partial", "functools.partial") and node.args:
+            if _dotted(node.args[0]) in jit_names:
+                return node
+    return None
+
+
+def _jit_aliases(tree: ast.AST) -> Set[str]:
+    """Names that mean jax.jit / jax.pmap in this file."""
+    names = {"jax.jit", "jax.pmap"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for a in node.names:
+                if a.name in ("jit", "pmap"):
+                    names.add(a.asname or a.name)
+    return names
+
+
+def _static_params(fn: ast.FunctionDef, call: Optional[ast.Call]) -> Set[str]:
+    """Parameter names declared static via static_argnames/static_argnums
+    on the jit decorator — those are Python values inside the trace, so
+    ``int(cap)``-style coercions on them are fine."""
+    if call is None:
+        return set()
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    out: Set[str] = set()
+    for kw in call.keywords:
+        v = kw.value
+        if kw.arg == "static_argnames":
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        out.add(e.value)
+        elif kw.arg == "static_argnums":
+            nums: List[int] = []
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums = [v.value]
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                nums = [
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                ]
+            for n in nums:
+                if 0 <= n < len(params):
+                    out.add(params[n])
+    return out
+
+
+# traced-callee POSITIONS per combinator: which positional args are
+# functions whose bodies execute under the trace (None = all from that
+# index on, for switch's branch list)
+_TRACED_ARG_POS = {
+    "scan": (0,),
+    "while_loop": (0, 1),   # cond_fun AND body_fun both trace
+    "fori_loop": (2,),      # (lower, upper, body_fun, init)
+    "cond": (1, 2),         # (pred, true_fun, false_fun, *operands)
+    "switch": (1,),         # (index, [branch_fns], *operands)
+    "vmap": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "pallas_call": (0,),
+}
+_COMBINATOR_PREFIXES = ("", "lax.", "jax.", "jax.lax.", "pl.",
+                        "jax.experimental.pallas.")
+
+
+def _traced_functions(
+    tree: ast.AST, jit_names: Set[str]
+) -> List[Tuple[ast.FunctionDef, Set[str], str]]:
+    """Every FunctionDef whose body executes under a trace:
+    (node, static param names, why)."""
+    out: List[Tuple[ast.FunctionDef, Set[str], str]] = []
+    # names handed to scan/cond/fori_loop/pallas_call... as traced callees
+    callee_names: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = _dotted(node.func)
+        base = f.split(".")[-1]
+        if base not in _TRACED_ARG_POS or not any(
+            f == p + base for p in _COMBINATOR_PREFIXES
+        ):
+            continue
+        for pos in _TRACED_ARG_POS[base]:
+            if pos >= len(node.args):
+                continue
+            arg = node.args[pos]
+            if isinstance(arg, ast.Name):
+                callee_names[arg.id] = base
+            elif isinstance(arg, (ast.List, ast.Tuple)):  # switch branches
+                for e in arg.elts:
+                    if isinstance(e, ast.Name):
+                        callee_names[e.id] = base
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if _is_jit_expr(dec, jit_names):
+                out.append(
+                    (node, _static_params(node, _jit_call_of(dec, jit_names)),
+                     "jit")
+                )
+                break
+        else:
+            if node.name in callee_names:
+                out.append((node, set(), callee_names[node.name]))
+    return out
+
+
+# -- rule: host-sync-in-jit -------------------------------------------------
+
+_NUMPY_ROOTS = {"np", "numpy", "onp"}
+_NUMPY_SYNC_FNS = {"asarray", "array", "ascontiguousarray", "copy"}
+
+
+class HostSyncInJit(Rule):
+    id = "host-sync-in-jit"
+    doc = (
+        "no .item()/bool()/int()/float() on traced values, np.asarray, "
+        "jax.device_get or .block_until_ready() inside jit/scan/"
+        "pallas_call bodies"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        jit_names = _jit_aliases(ctx.tree)
+        for fn, static, why in _traced_functions(ctx.tree, jit_names):
+            params = {
+                a.arg for a in fn.args.posonlyargs + fn.args.args
+                + fn.args.kwonlyargs
+            } - static
+            # nested defs inherit tracedness; their params are traced too
+            for inner in ast.walk(fn):
+                if isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if inner is not fn:
+                        params |= {
+                            a.arg for a in inner.args.posonlyargs
+                            + inner.args.args + inner.args.kwonlyargs
+                        }
+            yield from self._check_body(ctx, fn, params, why)
+
+    def _check_body(
+        self, ctx: FileContext, fn: ast.FunctionDef,
+        traced_params: Set[str], why: str,
+    ) -> Iterator[Finding]:
+        where = f"inside {why} body `{fn.name}`"
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "item" and not node.args:
+                    yield ctx.finding(
+                        self.id, node,
+                        f".item() forces a device->host sync {where}; "
+                        "keep the value on device or hoist the read out "
+                        "of the traced region",
+                    )
+                    continue
+                if f.attr == "block_until_ready":
+                    yield ctx.finding(
+                        self.id, node,
+                        f".block_until_ready() {where} serializes the "
+                        "trace against the device stream",
+                    )
+                    continue
+                root = _dotted(f).split(".")[0]
+                if root in _NUMPY_ROOTS and f.attr in _NUMPY_SYNC_FNS:
+                    if node.args and not _const_like(node.args[0]):
+                        yield ctx.finding(
+                            self.id, node,
+                            f"np.{f.attr}() {where} materializes the "
+                            "operand on host every call; use jnp.* or "
+                            "move the conversion outside the trace",
+                        )
+                    continue
+            d = _dotted(f)
+            if d in ("jax.device_get", "device_get"):
+                yield ctx.finding(
+                    self.id, node,
+                    f"jax.device_get {where} is a host sync; return the "
+                    "array and fetch after dispatch",
+                )
+                continue
+            if (
+                isinstance(f, ast.Name)
+                and f.id in ("bool", "int", "float")
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in traced_params
+            ):
+                yield ctx.finding(
+                    self.id, node,
+                    f"{f.id}({node.args[0].id}) {where} concretizes a "
+                    "traced value (TracerBoolConversionError at best, a "
+                    "silent per-call sync at worst); mark the argument "
+                    "static or keep the branch on device (lax.cond/"
+                    "jnp.where)",
+                )
+
+
+def _const_like(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_const_like(e) for e in node.elts)
+    return False
+
+
+# -- rule: recompile-hazard -------------------------------------------------
+
+class RecompileHazard(Rule):
+    id = "recompile-hazard"
+    doc = (
+        "jax.jit constructed inside a loop, or invoked inline "
+        "(jax.jit(f)(x)) — both defeat the compile cache"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        jit_names = _jit_aliases(ctx.tree)
+        loop_spans: List[Tuple[int, int]] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                end = getattr(node, "end_lineno", node.lineno)
+                loop_spans.append((node.lineno, end))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # inline invocation: jax.jit(f)(x) — a fresh wrapper per call
+            if isinstance(node.func, ast.Call):
+                inner = _jit_call_of(node.func, jit_names)
+                if inner is not None and inner.args:
+                    yield ctx.finding(
+                        self.id, node,
+                        "jax.jit(f)(...) creates and traces a fresh "
+                        "wrapper per call; bind the jitted function once "
+                        "(module scope or a cached builder) and call that",
+                    )
+                    continue
+            call = _jit_call_of(node, jit_names)
+            if call is None or not call.args:
+                continue
+            # decorator position is handled by normal function defs
+            if any(lo <= node.lineno <= hi for lo, hi in loop_spans):
+                yield ctx.finding(
+                    self.id, node,
+                    "jax.jit constructed inside a loop recompiles every "
+                    "iteration; hoist it out or cache it keyed on the "
+                    "static arguments (see ops/batch.py ClassedExpander."
+                    "_program)",
+                )
+
+
+# -- rule: wallclock-duration -----------------------------------------------
+
+def _is_walltime_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and _dotted(node.func) in ("time.time", "datetime.datetime.now")
+        and not node.args
+    )
+
+
+class WallClockDuration(Rule):
+    id = "wallclock-duration"
+    doc = (
+        "interval math on time.time() — deadlines, tick loops and age "
+        "computations must use time.monotonic()"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # scopes: module + each function gets its own timeish-name set
+        scopes: List[ast.AST] = [ctx.tree]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node)
+        seen: Set[int] = set()
+        for scope in scopes:
+            timeish = self._timeish_names(scope)
+            for node in self._walk_scope(scope):
+                if id(node) in seen:
+                    continue
+                hit = None
+                if isinstance(node, ast.BinOp) and isinstance(
+                    node.op, (ast.Add, ast.Sub)
+                ):
+                    if (
+                        _is_walltime_call(node.left)
+                        or _is_walltime_call(node.right)
+                        or self._timeish(node.left, timeish)
+                        or self._timeish(node.right, timeish)
+                    ):
+                        hit = (
+                            "duration/deadline arithmetic on time.time() "
+                            "drifts under NTP slew and can go backwards "
+                            "on clock steps; use time.monotonic() for "
+                            "intervals (wall clock is for user-visible "
+                            "timestamps only)"
+                        )
+                elif isinstance(node, ast.Compare):
+                    sides = [node.left] + list(node.comparators)
+                    if any(_is_walltime_call(s) for s in sides):
+                        hit = (
+                            "comparing time.time() against a deadline is "
+                            "interval logic; use time.monotonic()"
+                        )
+                if hit is not None:
+                    seen.add(id(node))
+                    yield ctx.finding(self.id, node, hit)
+
+    @classmethod
+    def _timeish_names(cls, scope: ast.AST) -> Set[str]:
+        # same scope boundary as the expression pass (_walk_scope):
+        # nested defs keep their own timeish sets — a closure's
+        # `ts = time.time()` must not taint the enclosing scope's `ts`
+        names: Set[str] = set()
+        for node in cls._walk_scope(scope):
+            if isinstance(node, ast.Assign) and _is_walltime_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+                    elif isinstance(t, ast.Tuple):
+                        names.update(
+                            e.id for e in t.elts if isinstance(e, ast.Name)
+                        )
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Tuple
+            ):
+                # total, t0 = 0, time.time()
+                for t in node.targets:
+                    if isinstance(t, ast.Tuple) and len(t.elts) == len(
+                        node.value.elts
+                    ):
+                        for tgt, val in zip(t.elts, node.value.elts):
+                            if isinstance(tgt, ast.Name) and _is_walltime_call(
+                                val
+                            ):
+                                names.add(tgt.id)
+        return names
+
+    @staticmethod
+    def _timeish(node: ast.AST, timeish: Set[str]) -> bool:
+        return isinstance(node, ast.Name) and node.id in timeish
+
+    @staticmethod
+    def _walk_scope(scope: ast.AST):
+        """Walk a scope without descending into nested function defs
+        (each gets its own pass with its own timeish set)."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+
+# -- rule: swallowed-exception ----------------------------------------------
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _broad_handler(h: ast.ExceptHandler) -> bool:
+    t = h.type
+    if t is None:
+        return True
+    if isinstance(t, (ast.Name, ast.Attribute)):
+        return _dotted(t).split(".")[-1] in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, (ast.Name, ast.Attribute))
+            and _dotted(e).split(".")[-1] in _BROAD
+            for e in t.elts
+        )
+    return False
+
+
+def _silent_body(body: Sequence[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, ast.Constant
+        ):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+class SwallowedException(Rule):
+    id = "swallowed-exception"
+    doc = (
+        "broad `except Exception: pass` hides partial outages; narrow "
+        "the type or count it (utils.metrics.note_swallowed)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _broad_handler(node) and _silent_body(node.body):
+                yield ctx.finding(
+                    self.id, node,
+                    "broad exception swallowed silently — a downed peer, "
+                    "a bad record and a typo all vanish here; catch the "
+                    "narrow type you mean, or at minimum count the drop "
+                    "via utils.metrics.note_swallowed(site, exc)",
+                )
+
+
+ALL_RULES: Tuple[Rule, ...] = (
+    HostSyncInJit(),
+    RecompileHazard(),
+    WallClockDuration(),
+    SwallowedException(),
+)

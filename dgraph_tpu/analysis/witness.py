@@ -1,0 +1,385 @@
+"""Runtime lock-order witness — lockdep for the Python side of the engine.
+
+The static pass (:mod:`.lockorder`) sees ``with`` nesting and same-class
+calls; it cannot see a scheduler worker that holds the engine read lock
+while the arena manager takes its cache lock while the hop cache takes
+its own — that order only exists at runtime, across objects and
+threads.  This recorder observes it.
+
+Mechanism: :func:`arm` swaps a proxy ``threading`` namespace into every
+loaded ``dgraph_tpu.*`` module, so locks **constructed after arming**
+are wrapper objects that report acquire/release to a global witness.
+Like lockdep, locks are grouped into *classes by construction site*
+(``sched/scheduler.py:135`` names every scheduler's condition); the
+witness maintains a per-thread held stack and a global first-seen order
+table of (held, acquired) pairs.  Seeing both (A, B) and (B, A) —
+from any two threads, any two tests, any two instances of the classes
+— is an inversion: the interleaving that deadlocks may never fire in
+CI, but the *order disagreement* is already provable.  Same-class
+pairs get a second, instance-serial table: two instances of ONE class
+taken in both orders (the two-caches ABBA that collapses to a
+self-edge at class level) is caught by wrapper serial, while true
+reentrancy on a single RLock instance stays exempt.  RWLocks are
+instrumented at the class level (read and write side both count as
+holding the lock class; their internal condition is deliberately NOT
+witnessed — it would only add leaf noise).
+
+Exclusions (documented, deliberate):
+
+- ``utils.metrics`` — its locks are hot leaf locks (verified: no
+  metric method calls out while holding one); witnessing them costs
+  measurable tier-1 time for zero ordering information;
+- locks created at import time (``models.arena._BUILD_LOCK``,
+  ``native._lock``) predate arming — the static pass covers their
+  nesting;
+- ``analysis.*`` itself.
+
+Armed for the whole tier-1 run by ``tests/conftest.py``; any inversion
+fails the session.  ``Witness()`` instances can also be used directly
+(the seeded-inversion test in tests/test_analysis.py does).
+"""
+
+from __future__ import annotations
+
+import itertools
+import sys
+import threading as _real_threading
+from typing import Dict, List, Optional, Tuple
+
+_INFRA_FILES = ("analysis/witness.py", "utils/rwlock.py", "threading.py")
+
+# per-wrapper monotonic serials (NOT id(): ids recycle after GC and a
+# recycled id could alias a dead lock into a false inversion)
+_serial = itertools.count(1)
+
+
+def _creation_site(skip: int = 2) -> str:
+    """file:line of the nearest non-infrastructure caller frame."""
+    best = None
+    f = sys._getframe(skip)
+    for _ in range(10):
+        if f is None:
+            break
+        fn = f.f_code.co_filename.replace("\\", "/")
+        if not any(fn.endswith(s) for s in _INFRA_FILES):
+            short = "/".join(fn.rsplit("/", 3)[-3:])
+            return f"{short}:{f.f_lineno}"
+        if best is None:
+            short = "/".join(fn.rsplit("/", 3)[-3:])
+            best = f"{short}:{f.f_lineno}"
+        f = f.f_back
+    return best or "<unknown>"
+
+
+class Witness:
+    """Order table + per-thread held stacks.  All bookkeeping uses REAL
+    threading primitives and never calls out while holding its own lock
+    (the witness must not deadlock the system it watches)."""
+
+    def __init__(self) -> None:
+        self._mu = _real_threading.Lock()
+        self._tls = _real_threading.local()
+        # class level: (a, b) -> "a@siteA -> b@siteB" for the FIRST
+        # observation of class b acquired while class a held
+        self._order: Dict[Tuple[str, str], str] = {}
+        # instance level, for SAME-class pairs only: two instances of
+        # one lock class taken in both orders is the classic ABBA the
+        # class table cannot see (both directions collapse to a
+        # self-edge).  Keyed by wrapper serials; bounded below.
+        self._inst_order: Dict[Tuple[int, int], str] = {}
+        self._inst_saturated = False
+        self._inversions: List[str] = []
+        self.active = True
+
+    _INST_CAP = 100_000  # instance-pair table bound (serials churn)
+
+    # -- core events --------------------------------------------------------
+
+    def _held(self) -> List[Tuple[str, int]]:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def note_acquire(self, name: str, serial: int = 0) -> None:
+        if not self.active:
+            return
+        held = self._held()
+        if held:
+            site = None
+            for h, hs in held:
+                if h == name:
+                    if not serial or not hs or hs == serial:
+                        continue  # reentrant (RLock) — not an order fact
+                    # same class, DIFFERENT instances: track by serial
+                    if (hs, serial) not in self._inst_order:
+                        if site is None:
+                            site = _creation_site(2)
+                        with self._mu:
+                            if (hs, serial) not in self._inst_order:
+                                if len(self._inst_order) < self._INST_CAP:
+                                    self._inst_order[(hs, serial)] = site
+                                elif not self._inst_saturated:
+                                    # no silent caps: past this point
+                                    # same-class inversion detection is
+                                    # degraded — say so once, loudly
+                                    self._inst_saturated = True
+                                    print(
+                                        "graftcheck witness: instance-"
+                                        f"order table hit its {self._INST_CAP}"
+                                        "-pair cap; same-class inversion "
+                                        "detection is degraded for the "
+                                        "rest of this run",
+                                        file=sys.stderr,
+                                    )
+                                rev = self._inst_order.get((serial, hs))
+                                if rev is not None:
+                                    self._inversions.append(
+                                        "lock-order inversion (two "
+                                        f"instances of class {name}): "
+                                        f"#{hs} -> #{serial} @ {site} BUT "
+                                        f"#{serial} -> #{hs} @ {rev}"
+                                    )
+                    continue
+                if (h, name) not in self._order:  # racy pre-check is fine:
+                    # worst case two threads compute the site; insert
+                    # below is serialized under _mu
+                    if site is None:
+                        site = _creation_site(2)
+                    with self._mu:
+                        if (h, name) not in self._order:
+                            self._order[(h, name)] = f"{h} then {name} @ {site}"
+                            rev = self._order.get((name, h))
+                            if rev is not None:
+                                self._inversions.append(
+                                    f"lock-order inversion: [{name} -> {h}] "
+                                    f"seen as {rev}; BUT [{h} -> {name}] "
+                                    f"seen as {self._order[(h, name)]}"
+                                )
+        held.append((name, serial))
+
+    def note_release(self, name: str, serial: int = 0) -> None:
+        if not self.active:
+            return
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == name and (not serial or held[i][1] == serial):
+                del held[i]
+                return
+
+    # -- reporting ----------------------------------------------------------
+
+    def inversions(self) -> List[str]:
+        with self._mu:
+            return list(self._inversions)
+
+    def edges(self) -> Dict[Tuple[str, str], str]:
+        with self._mu:
+            return dict(self._order)
+
+
+# -- wrapper primitives -----------------------------------------------------
+
+class _WLock:
+    """threading.Lock/RLock wrapper reporting to a witness."""
+
+    def __init__(self, witness: Witness, name: str, inner) -> None:
+        self._w = witness
+        self._name = name
+        self._inner = inner
+        self._ws = next(_serial)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._w.note_acquire(self._name, self._ws)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        self._w.note_release(self._name, self._ws)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<witnessed {self._name} {self._inner!r}>"
+
+
+class _WCondition(_real_threading.Condition):
+    """Condition subclass reporting to a witness.  ``wait`` releases the
+    underlying lock, so the held-stack entry pops for the wait's
+    duration — otherwise every post-wait acquisition would look nested
+    under the condition."""
+
+    def __init__(self, witness: Witness, name: str, lock=None) -> None:
+        super().__init__(lock)
+        self._wname = name
+        self._w = witness
+        self._ws = next(_serial)
+        # threading.Condition.__init__ binds self.acquire/self.release
+        # as INSTANCE attributes (the inner lock's bound methods), which
+        # would shadow any class-level override — rebind them here so
+        # direct cond.acquire()/release() calls are witnessed too.
+        # (Condition.wait uses _release_save/_acquire_restore, which go
+        # straight to the inner lock — our wait() override covers that.)
+        inner_acquire, inner_release = self.acquire, self.release
+
+        def acquire(*a, **k):
+            ok = inner_acquire(*a, **k)
+            if ok:
+                self._w.note_acquire(self._wname, self._ws)
+            return ok
+
+        def release():
+            self._w.note_release(self._wname, self._ws)
+            inner_release()
+
+        self.acquire = acquire
+        self.release = release
+
+    def __enter__(self):
+        r = super().__enter__()
+        self._w.note_acquire(self._wname, self._ws)
+        return r
+
+    def __exit__(self, *exc):
+        self._w.note_release(self._wname, self._ws)
+        return super().__exit__(*exc)
+
+    def wait(self, timeout: Optional[float] = None):
+        self._w.note_release(self._wname, self._ws)
+        try:
+            return super().wait(timeout)
+        finally:
+            self._w.note_acquire(self._wname, self._ws)
+    # wait_for() is inherited and loops over wait() — covered.
+
+
+class _ThreadingProxy:
+    """Module-shaped object delegating to real ``threading`` with the
+    lock constructors swapped for witnessing ones.  Injected into a
+    module's ``threading`` global, so only dgraph_tpu code sees it."""
+
+    def __init__(self, witness: Witness) -> None:
+        self._w = witness
+
+    def Lock(self):
+        return _WLock(self._w, _creation_site(), _real_threading.Lock())
+
+    def RLock(self):
+        return _WLock(self._w, _creation_site(), _real_threading.RLock())
+
+    def Condition(self, lock=None):
+        return _WCondition(self._w, _creation_site(), lock)
+
+    def __getattr__(self, name: str):
+        return getattr(_real_threading, name)
+
+
+# -- arming -----------------------------------------------------------------
+
+_EXCLUDE_MODULES = (
+    "dgraph_tpu.analysis",
+    "dgraph_tpu.utils.metrics",   # hot leaf locks, verified no fan-out
+    "dgraph_tpu.utils.rwlock",    # instrumented at class level below
+)
+
+_global: Optional[Witness] = None
+_patched: List[Tuple[object, str, object]] = []  # (obj, attr, original)
+
+
+def arm() -> Witness:
+    """Install the witness into every loaded dgraph_tpu module (and any
+    imported later gets covered when arm() is called again — conftest
+    arms once after test collection, which imports everything).
+    Idempotent; returns the global witness."""
+    global _global
+    if _global is None:
+        _global = Witness()
+    w = _global
+    proxy = _ThreadingProxy(w)
+    for name, mod in list(sys.modules.items()):
+        if mod is None or not name.startswith("dgraph_tpu"):
+            continue
+        if any(name.startswith(e) for e in _EXCLUDE_MODULES):
+            continue
+        cur = getattr(mod, "threading", None)
+        if cur is _real_threading:
+            _patched.append((mod, "threading", cur))
+            mod.threading = proxy
+    _instrument_rwlock(w)
+    return w
+
+
+def disarm() -> None:
+    """Restore patched namespaces.  Wrapper locks already embedded in
+    live objects keep functioning (the witness just goes inactive)."""
+    global _global
+    for obj, attr, orig in _patched:
+        setattr(obj, attr, orig)
+    _patched.clear()
+    if _global is not None:
+        _global.active = False
+        _global = None
+
+
+def current() -> Optional[Witness]:
+    return _global
+
+
+def _instrument_rwlock(w: Witness) -> None:
+    """Patch RWLock at the class level: read and write side both count
+    as holding the lock's class (an RWLock inversion is an inversion no
+    matter which side each thread took — the write side excludes both)."""
+    from dgraph_tpu.utils import rwlock as _rw
+
+    if getattr(_rw.RWLock, "_witnessed", False):
+        return
+    _rw.RWLock._witnessed = True
+    orig_init = _rw.RWLock.__init__
+    orig = {
+        m: getattr(_rw.RWLock, m)
+        for m in ("acquire_read", "release_read", "acquire_write",
+                  "release_write")
+    }
+
+    def __init__(self):  # noqa: N807
+        orig_init(self)
+        self._witness_name = _creation_site()
+        self._witness_serial = next(_serial)
+
+    def make(method, note_after_acquire: bool):
+        o = orig[method]
+        if note_after_acquire:
+            def wrapped(self):
+                o(self)
+                wit = current()
+                if wit is not None:
+                    wit.note_acquire(
+                        getattr(self, "_witness_name", "rwlock"),
+                        getattr(self, "_witness_serial", 0),
+                    )
+        else:
+            def wrapped(self):
+                wit = current()
+                if wit is not None:
+                    wit.note_release(
+                        getattr(self, "_witness_name", "rwlock"),
+                        getattr(self, "_witness_serial", 0),
+                    )
+                o(self)
+        return wrapped
+
+    _rw.RWLock.__init__ = __init__
+    _rw.RWLock.acquire_read = make("acquire_read", True)
+    _rw.RWLock.acquire_write = make("acquire_write", True)
+    _rw.RWLock.release_read = make("release_read", False)
+    _rw.RWLock.release_write = make("release_write", False)

@@ -90,7 +90,7 @@ def load_file(
     in_flight: list = []
     chunk_end = 0
     n = 0
-    t0 = time.time()
+    t0 = time.monotonic()  # interval math only: rate + progress beats
     last_report = t0
 
     def drain():
@@ -124,7 +124,7 @@ def load_file(
         n += 1
         if len(pending) >= batch:
             submit_chunk()
-            now = time.time()
+            now = time.monotonic()
             if now - last_report >= progress_every:
                 rate = n / max(now - t0, 1e-9)
                 print(f"  {path}: {n} quads, {rate:,.0f}/s", file=sys.stderr)
@@ -171,11 +171,11 @@ def main(argv=None) -> int:
             client.add_schema(f.read())
         print(f"applied schema from {ns.schema}", file=sys.stderr)
 
-    total, t0 = 0, time.time()
+    total, t0 = 0, time.monotonic()
     for path in ns.rdf:
         total += load_file(client, path, marks, batch=ns.batch, window=ns.concurrent)
     client.close()
-    dt = time.time() - t0
+    dt = time.monotonic() - t0
     print(f"loaded {total} quads in {dt:.1f}s ({total / max(dt, 1e-9):,.0f}/s)")
     return 0
 

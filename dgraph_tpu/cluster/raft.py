@@ -363,12 +363,12 @@ class RaftNode:
         if self.state == LEADER and self.peers and self._thread is not None:
             self._transfer_sent = False
             self._inbox.put(("transfer",))
-            deadline = time.time() + 2.0
+            deadline = time.monotonic() + 2.0
             # exit on demotion (new leader's message reached us) OR once
             # TimeoutNow has flown plus a short grace — when our inbound
             # plane is already closing we can't observe the demotion, and
             # the handoff itself completes on the survivors' side
-            while self.state == LEADER and time.time() < deadline:
+            while self.state == LEADER and time.monotonic() < deadline:
                 if self._transfer_sent:
                     time.sleep(self.tick_s * 4)
                     break

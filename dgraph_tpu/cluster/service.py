@@ -228,8 +228,8 @@ class ClusterService:
         replica (shared by xid assignment, schema apply and join)."""
         import time
 
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             if cond():
                 return True
             time.sleep(0.005)

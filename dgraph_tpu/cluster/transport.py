@@ -367,8 +367,11 @@ class GrpcRaftTransport(_QueuedPeerTransport):
                 if ch is not None:
                     try:
                         ch.close()
-                    except Exception:
-                        pass
+                    except Exception as e:  # noqa: BLE001 — grpc close
+                        # failures are unactionable here, but visible
+                        from dgraph_tpu.utils.metrics import note_swallowed
+
+                        note_swallowed("transport.channel_close", e)
 
     def _channel_for(self, addr: str):
         import grpc
@@ -387,11 +390,14 @@ class GrpcRaftTransport(_QueuedPeerTransport):
             return ch
 
     def _sender(self, peer: str, q: "queue.Queue") -> None:
+        import grpc
+
         from dgraph_tpu.serve.grpc_server import (
             _SECRET_MD,
             encode_payload,
             frame_raft,
         )
+        from dgraph_tpu.utils.metrics import note_swallowed
 
         md = [(_SECRET_MD, self.secret)] if self.secret else None
         cur_addr = None
@@ -412,20 +418,36 @@ class GrpcRaftTransport(_QueuedPeerTransport):
                         "/protos.Worker/RaftMessage"
                     )
                     cur_addr = addr
-                rpc(
-                    encode_payload(frame_raft(group, body)),
-                    timeout=self.timeout,
-                    metadata=md,
-                )
-            except Exception:
-                pass  # peer down: drop, heartbeats will retry
+                payload = encode_payload(frame_raft(group, body))
+                try:
+                    rpc(payload, timeout=self.timeout, metadata=md)
+                except ValueError as e:
+                    # the channel closed under us mid-call; scoped to
+                    # the rpc ONLY — a ValueError out of encode_payload
+                    # is a bug and must reach the unexpected handler
+                    note_swallowed("transport.grpc_send", e)
+            except (grpc.RpcError, OSError) as e:
+                # peer down: drop, heartbeats will retry — but a peer
+                # that stays down shows up as a counter rate
+                note_swallowed("transport.grpc_send", e)
+            except Exception as e:  # noqa: BLE001 — ANY other failure
+                # (encode bug, channel-construction surprise) must not
+                # kill this peer's only sender thread for the process
+                # lifetime; count under its own site AND print — an
+                # unexpected type here is a bug worth a traceback
+                import traceback
+
+                note_swallowed("transport.sender_unexpected", e)
+                traceback.print_exc()
 
     def stop(self) -> None:
         super().stop()
+        from dgraph_tpu.utils.metrics import note_swallowed
+
         with self._lock:
             for ch in self._chans.values():
                 try:
                     ch.close()
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001 — best-effort teardown
+                    note_swallowed("transport.channel_close", e)
             self._chans.clear()

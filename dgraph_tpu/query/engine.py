@@ -1315,6 +1315,10 @@ def _eval_math_vec(mt: MathTree, ua: np.ndarray, value_vars):
     if fn == "since":
         import time
 
+        # since() is wall-clock BY DEFINITION: it subtracts a stored,
+        # user-visible timestamp from "now" — monotonic time has no
+        # relation to stored epochs.
+        # graftlint: ignore[wallclock-duration]
         return time.time() - kid_vals[0], ok
     if fn == "max":
         return np.maximum.reduce(kid_vals), ok

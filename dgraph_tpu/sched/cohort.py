@@ -261,7 +261,7 @@ class HopMerger:
                     saved = len(entries) - 1
                     self.merged_dispatches += saved
                     SCHED_MERGED_HOPS.add(saved)
-            except BaseException as e:  # propagate to every member
+            except BaseException as e:  # noqa: BLE001 — propagate to every member
                 g.error = e
             finally:
                 g.done.set()

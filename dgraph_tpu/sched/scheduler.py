@@ -387,7 +387,7 @@ class CohortScheduler:
                             self._run_one(req, merger)
                         else:
                             req.fail(lead.error)
-        except BaseException as e:  # lock failure etc.: fail, never hang
+        except BaseException as e:  # noqa: BLE001 — lock failure etc.: fail, never hang
             for req in live:
                 if req.result is None and req.error is None:
                     req.fail(e)
@@ -451,7 +451,7 @@ class CohortScheduler:
             if srv.dumpsg_path and eng.last_dump:
                 srv._dump_subgraphs(eng.last_dump)
             req.complete(out, dict(eng.stats))
-        except BaseException as e:
+        except BaseException as e:  # noqa: BLE001 — delivered via req.fail
             req.fail(e)
         finally:
             merger.leave()

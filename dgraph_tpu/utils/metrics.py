@@ -246,3 +246,20 @@ QCACHE_HIT_AGE = metrics.histogram(
     "dgraph_qcache_hit_age_seconds",
     (0.001, 0.01, 0.1, 1.0, 10.0, 60.0, 600.0),
 )
+
+# deliberately-swallowed exceptions (graftlint: swallowed-exception).
+# Some drops are correct — a raft frame to a downed peer retries via the
+# next heartbeat — but "correct to drop" never means "correct to drop
+# invisibly": a peer that is down for an hour shows up here as a rate an
+# operator can alert on, instead of as silence.
+SWALLOWED_EXC = metrics.labeled(
+    "dgraph_swallowed_exceptions_total", label="site"
+)
+
+
+def note_swallowed(site: str, exc: BaseException) -> None:
+    """Count an intentionally-dropped exception at ``site`` (a short
+    dotted location like ``transport.grpc_send``).  The exception TYPE
+    rides in the label so a sudden shift (OSError → ValueError) is
+    visible without logs."""
+    SWALLOWED_EXC.add(f"{site}:{type(exc).__name__}")

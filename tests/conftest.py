@@ -21,3 +21,66 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+# ---------------------------------------------------------------------------
+# graftcheck runtime invariants (dgraph_tpu/analysis/, docs/analysis.md):
+#
+# 1. compile-count budgets: every XLA compilation is counted via
+#    jax.monitoring; each test's delta is checked against
+#    analysis/budgets.json (pytest_runtest_call is imported below — in
+#    conftest namespace it registers as a hook).  @pytest.mark.
+#    compile_budget(n) overrides; @pytest.mark.transfer_guard wraps the
+#    test in jax.transfer_guard.
+# 2. lock-order witness: lock constructors in dgraph_tpu modules are
+#    wrapped so every acquisition feeds a lockdep-style order table;
+#    observing both (A before B) and (B before A) anywhere in the run
+#    fails the session.  DGRAPH_TPU_WITNESS=0 disables (e.g. when
+#    bisecting a perf delta).
+# ---------------------------------------------------------------------------
+
+from dgraph_tpu.analysis import witness as _witness  # noqa: E402
+from dgraph_tpu.analysis.pytest_budget import (  # noqa: E402,F401
+    budget_plugin_configure,
+    budget_plugin_report,
+    pytest_runtest_call,  # hook: budget + transfer-guard enforcement
+)
+
+_WITNESS_ON = os.environ.get("DGRAPH_TPU_WITNESS", "1") != "0"
+
+
+def pytest_configure(config):
+    budget_plugin_configure(config)
+    if _WITNESS_ON:
+        _witness.arm()
+
+
+def pytest_runtest_setup(item):
+    # re-arm per test: modules imported lazily since the last arm (test
+    # bodies do `from dgraph_tpu.cache import ...` at call time) get
+    # their lock constructors wrapped too.  Idempotent and cheap — a
+    # prefix scan of sys.modules.
+    if _WITNESS_ON:
+        _witness.arm()
+
+
+def pytest_terminal_summary(terminalreporter):
+    budget_plugin_report(terminalreporter)
+    w = _witness.current()
+    if w is not None:
+        inv = w.inversions()
+        if inv:
+            terminalreporter.write_line("")
+            terminalreporter.write_line(
+                "LOCK-ORDER INVERSIONS OBSERVED (witness recorder):",
+                red=True,
+            )
+            for line in inv:
+                terminalreporter.write_line("  " + line, red=True)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    w = _witness.current()
+    if w is not None and w.inversions() and session.exitstatus == 0:
+        # an inversion is a deadlock waiting for the right interleaving:
+        # fail the run even when every individual test passed
+        session.exitstatus = 1
