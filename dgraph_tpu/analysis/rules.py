@@ -23,6 +23,11 @@ on a noisy 2-core CPU host:
   cluster/raft/loader code turns partial outages into silent data
   gaps; narrow the type or count it via
   ``utils.metrics.note_swallowed`` so operators can see the drop rate.
+- ``naked-peer-rpc``: a direct ``urlopen_peer`` (anywhere) or raw
+  channel-RPC call (in the cluster peer plane) bypasses PeerClient's
+  retry budget, circuit breaker and health ordering — exactly the
+  one-shot brittleness PR 5 removed; route it through
+  ``cluster/peerclient.py``.
 
 Suppress a deliberate site with ``# graftlint: ignore[rule-id]`` on the
 line (or the line above).  docs/analysis.md has the full catalog and
@@ -480,9 +485,60 @@ class SwallowedException(Rule):
                 )
 
 
+# -- rule: naked-peer-rpc ---------------------------------------------------
+
+_CHANNEL_RPC_ATTRS = {
+    "unary_unary", "unary_stream", "stream_unary", "stream_stream",
+}
+
+
+class NakedPeerRpc(Rule):
+    id = "naked-peer-rpc"
+    doc = (
+        "direct urlopen_peer / channel-RPC call outside cluster/"
+        "peerclient.py — peer RPCs must route through PeerClient "
+        "(retry budget, per-peer circuit breaker, health ordering)"
+    )
+
+    # ``urlopen_peer`` is flagged EVERYWHERE (it exists only for peer
+    # calls); raw gRPC multicallables are flagged only under cluster/ —
+    # serve/ChannelPool and client/ are the PUBLIC API surface, where a
+    # naked channel RPC is the client's own business.
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if path.endswith("cluster/peerclient.py"):
+            return  # the one legitimate home of both call forms
+        in_cluster = "cluster/" in path
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = _dotted(f).split(".")[-1]
+            if name == "urlopen_peer":
+                yield ctx.finding(
+                    self.id, node,
+                    "one-shot urlopen_peer call bypasses PeerClient: no "
+                    "retry/backoff budget, no circuit breaker, and a "
+                    "down peer costs a full connect timeout here — use "
+                    "ClusterService.peerclient.urlopen(...)",
+                )
+            elif (
+                in_cluster
+                and isinstance(f, ast.Attribute)
+                and f.attr in _CHANNEL_RPC_ATTRS
+            ):
+                yield ctx.finding(
+                    self.id, node,
+                    f"raw channel.{f.attr}() in the cluster peer plane "
+                    "bypasses PeerClient — use peerclient.grpc_unary(...) "
+                    "so retries/breakers cover this RPC too",
+                )
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     HostSyncInJit(),
     RecompileHazard(),
     WallClockDuration(),
     SwallowedException(),
+    NakedPeerRpc(),
 )

@@ -30,6 +30,22 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from dgraph_tpu.models import codec
 from dgraph_tpu.models.wal import Wal, replay_records
+from dgraph_tpu.utils.env import env_float
+
+
+def propose_patience(timeout: Optional[float] = None) -> float:
+    """How long a proposer waits for commit+apply before giving up.
+
+    ``DGRAPH_TPU_PROPOSE_TIMEOUT`` overrides the 10s default (read at
+    call time so tests can set it per-module): on a slow or instrumented
+    host a single commit+apply round trip can exceed 10s, and a
+    timed-out proposal invites the client to re-post a duplicate that
+    queues behind the still-running original — patience here is what
+    breaks that amplification loop.  An explicit ``timeout`` argument
+    always wins."""
+    if timeout is not None:
+        return timeout
+    return env_float("DGRAPH_TPU_PROPOSE_TIMEOUT", 10.0)
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
@@ -406,9 +422,9 @@ class RaftNode:
         quorum forever.  Idempotent; removing an absent peer is a no-op."""
         self._inbox.put(("conf_remove", nid))
 
-    def propose_and_wait(self, data: bytes, timeout: float = 10.0):
+    def propose_and_wait(self, data: bytes, timeout: Optional[float] = None):
         """draft.go:341 ProposeAndWait: block until applied or error."""
-        return self.propose(data).result(timeout=timeout)
+        return self.propose(data).result(timeout=propose_patience(timeout))
 
     @property
     def is_leader(self) -> bool:

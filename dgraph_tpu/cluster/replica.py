@@ -189,16 +189,20 @@ class ReplicatedGroup:
 
     # -- public write path ---------------------------------------------------
 
-    def propose_edges(self, edges: List[Edge], timeout: float = 10.0) -> None:
+    def propose_edges(
+        self, edges: List[Edge], timeout: Optional[float] = None
+    ) -> None:
         """MutateOverNetwork's per-group proposeOrSend (mutation.go:319)."""
         self.node.propose_and_wait(
             encode_batch([codec.encode_edge(e) for e in edges]), timeout
         )
 
-    def propose_schema(self, text: str, timeout: float = 10.0) -> None:
+    def propose_schema(self, text: str, timeout: Optional[float] = None) -> None:
         self.node.propose_and_wait(
             encode_batch([codec.encode_schema(text)]), timeout
         )
 
-    def propose_records(self, records: List[bytes], timeout: float = 10.0) -> None:
+    def propose_records(
+        self, records: List[bytes], timeout: Optional[float] = None
+    ) -> None:
         self.node.propose_and_wait(encode_batch(records), timeout)

@@ -25,6 +25,7 @@ reference.
 
 from __future__ import annotations
 
+import http.client
 import threading
 import urllib.error
 import urllib.request
@@ -35,13 +36,17 @@ from dgraph_tpu.models.store import Edge, PostingStore, PredicateData
 from dgraph_tpu.models.schema import SchemaState
 from dgraph_tpu.cluster.groups import GroupConfig
 from dgraph_tpu.cluster.lease import LeaseManager
-from dgraph_tpu.cluster.raft import NotLeaderError
+from dgraph_tpu.cluster.peerclient import (
+    PeerClient,
+    StaleUnavailableError,
+    resilience_enabled,
+)
+from dgraph_tpu.cluster.raft import NotLeaderError, propose_patience
 from dgraph_tpu.cluster.replica import ReplicatedGroup, encode_batch
 from dgraph_tpu.cluster.transport import (
     HttpRaftTransport,
     PeerAuth,
     decode_msg,
-    urlopen_peer,
 )
 
 METADATA_GROUP = 0
@@ -115,6 +120,11 @@ class ClusterService:
         else:
             self.conf = GroupConfig.single_group()
         self.auth = PeerAuth(secret=secret, cafile=peer_ca, insecure=peer_tls_insecure)
+        # one PeerClient for every peer RPC this server issues — the
+        # retry/backoff/breaker funnel (cluster/peerclient.py); the raft
+        # transports share it so a peer that times out on the read plane
+        # is ALSO known-bad to the raft sender loops (and vice versa)
+        self.peerclient = PeerClient(auth=self.auth)
         others = {nid: a for nid, a in self.peers.items() if nid != node_id}
         if raft_transport == "grpc":
             # raft frames over the gRPC Worker plane (the reference's
@@ -129,9 +139,12 @@ class ClusterService:
                 secret=secret,
                 port_offset=grpc_port_offset,
                 auth=self.auth,
+                peerclient=self.peerclient,
             )
         else:
-            self.transport = HttpRaftTransport(others, auth=self.auth)
+            self.transport = HttpRaftTransport(
+                others, auth=self.auth, peerclient=self.peerclient
+            )
         # static placement (group/conf.go's server-side complement): which
         # groups each peer serves.  None/missing peer = serves everything
         # (full replication, the pre-placement behavior).  The metadata
@@ -220,6 +233,21 @@ class ClusterService:
 
     def has_leader(self) -> bool:
         return all(g.node.leader_id is not None for g in self.groups.values())
+
+    def health_summary(self) -> dict:
+        """Peer/breaker/raft-leader state for the /health endpoint."""
+        return {
+            "node": self.node_id,
+            "peers": self.peerclient.snapshot(),
+            "raft": {
+                str(gid): {
+                    "leader": g.node.leader_id,
+                    "is_leader": g.node.is_leader,
+                }
+                for gid, g in sorted(self.groups.items())
+            },
+            "degraded": self.store.degraded_info(),
+        }
 
     # -- runtime membership (JoinCluster, draft.go:1049 / groups.go:600) ----
 
@@ -333,7 +361,27 @@ class ClusterService:
             ).encode(),
             headers={"Content-Type": "application/json"},
         )
-        with urlopen_peer(req, timeout, self.auth) as resp:
+        # key the breaker/metrics by the seed's node id when we know it
+        # (static peer lists) so /health and dgraph_peer_rpc_total keep
+        # one namespace per peer; a runtime joiner booted with only
+        # itself has nothing better than the address yet
+        seed_key = next(
+            (
+                nid
+                for nid, a in self.peers.items()
+                if a.rstrip("/") == seed_addr.rstrip("/")
+            ),
+            seed_addr,
+        )
+        # slice_budget=False, like forward: the seed server legitimately
+        # blocks while the MEMBER record commits + applies
+        # (_wait_local_apply), so a budget slice times out a join that
+        # was about to succeed on a loaded host — the first attempt owns
+        # the window, the retry covers only fast transport failures
+        with self.peerclient.urlopen(
+            seed_key, req, op="join", budget=timeout, attempts=2,
+            slice_budget=False,
+        ) as resp:
             got = _json.loads(resp.read())
         for nid, addr in got["peers"].items():
             self._on_member_applied(nid, addr)
@@ -345,17 +393,20 @@ class ClusterService:
         if g is not None:
             g.node.deliver(decode_msg(body))
 
-    def propose_local(self, group: int, batch: bytes, timeout: float = 10.0) -> None:
+    def propose_local(
+        self, group: int, batch: bytes, timeout: Optional[float] = None
+    ) -> None:
         """Propose on THIS server; raises NotLeaderError for the forwarder."""
-        self.groups[group].node.propose_and_wait(batch, timeout)
+        self.groups[group].node.propose_and_wait(batch, propose_patience(timeout))
 
     def propose_records(
-        self, group: int, records: List[bytes], timeout: float = 10.0
+        self, group: int, records: List[bytes], timeout: Optional[float] = None
     ) -> None:
         """Propose, forwarding to the leader over HTTP when we're not it
         (proposeOrSend: local → ProposeAndWait, remote → RPC).  A group
         this server does not place routes straight to that group's
         servers (MutateOverNetwork's remote grpc Mutate leg)."""
+        timeout = propose_patience(timeout)
         batch = encode_batch(records)
         if group not in self.groups:
             return self._propose_remote_group(group, batch, timeout)
@@ -424,7 +475,23 @@ class ClusterService:
             url, data=batch, headers={"Content-Type": "application/octet-stream"}
         )
         try:
-            with urlopen_peer(req, timeout + 2, self.auth) as resp:
+            # budget = the proposal timeout (the old blanket `timeout+2`
+            # survives only as the RESILIENCE=0 single-shot timeout);
+            # transport failures retry with backoff inside the budget,
+            # 409 leader hints come back instantly as HTTPError.
+            # slice_budget=False: a forwarded proposal legitimately
+            # BLOCKS while the leader commits+applies, so the FIRST
+            # attempt must own the whole window — a half-window slice
+            # times out work about to succeed and re-POSTs a duplicate
+            # batch at the slow leader (the amplification loop the
+            # propose_patience docstring describes); the retry only
+            # fires on fast transport failures that leave the budget
+            # intact
+            with self.peerclient.urlopen(
+                peer, req, op="forward",
+                budget=timeout, attempts=2, off_timeout=timeout + 2,
+                slice_budget=False,
+            ) as resp:
                 resp.read()
                 return None, None, True
         except urllib.error.HTTPError as e:
@@ -440,6 +507,30 @@ class ClusterService:
 
     # -- cross-server reads (ServeTask analog, worker/task.go:54-68) --------
 
+    def _iter_replicas(self, gid: int, op: str, timeout: float):
+        """Shared cross-server-read replica walk: yields
+        ``(nid, addr, per_replica_budget)`` for ``gid``'s servers,
+        healthiest replica first (AnyServer read balancing, breaker-
+        aware: the replica that just timed out sorts last, and its open
+        breaker sheds in microseconds rather than re-stalling).  The
+        overall ``timeout`` budget is split over the replicas still
+        untried — a cold-breaker blackholed first replica must not
+        starve a healthy second one of its chance (the last replica
+        keeps everything that is left) — and iteration stops once the
+        budget is spent (legacy one-shot semantics keep going when
+        resilience is off)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        members = self.peerclient.order_by_health(
+            self.servers_of_group(gid), op=op
+        )
+        for i, (nid, addr) in enumerate(members):
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0 and resilience_enabled():
+                break  # the fetch's OVERALL budget is spent
+            yield nid, addr, remaining / (len(members) - i)
+
     def fetch_pred_snapshot(
         self, pred: str, gid: int, since: int, timeout: float = 10.0
     ):
@@ -451,10 +542,12 @@ class ClusterService:
         the predicate and builds device arenas from it locally, so one
         transfer serves every subsequent query until the owner mutates.
         Raises OSError when no owning server is reachable."""
-        last_err: Optional[Exception] = None
-        for _nid, addr in self.servers_of_group(gid):
-            from urllib.parse import quote
+        from urllib.parse import quote
 
+        last_err: Optional[Exception] = None
+        for nid, addr, per_replica in self._iter_replicas(
+            gid, "snapshot", timeout
+        ):
             url = (
                 f"{addr}/pred-snapshot?name="
                 + quote(pred, safe="")
@@ -462,7 +555,10 @@ class ClusterService:
             )
             req = urllib.request.Request(url)
             try:
-                with urlopen_peer(req, timeout, self.auth) as resp:
+                with self.peerclient.urlopen(
+                    nid, req, op="snapshot",
+                    budget=per_replica, off_timeout=timeout,
+                ) as resp:
                     ver = int(resp.headers.get("X-Pred-Version", "0"))
                     if resp.status == 204:
                         return ver, None
@@ -473,6 +569,16 @@ class ClusterService:
                 last_err = e
             except OSError as e:
                 last_err = e
+            except http.client.HTTPException as e:
+                # an owner killed MID-RESPONSE truncates the body:
+                # resp.read() raises IncompleteRead — an HTTPException,
+                # not an OSError — after the peerclient attempt already
+                # counted success.  Same remedy as a transport error:
+                # try the next replica (legacy one-shot semantics keep
+                # the pre-PR immediate propagation)
+                if not resilience_enabled():
+                    raise
+                last_err = e
         raise last_err or OSError(f"no server for group {gid}")
 
     def fetch_predlist(self, gid: int, timeout: float = 5.0) -> Optional[List[str]]:
@@ -481,10 +587,15 @@ class ClusterService:
         group, so stale caches converge after deletes)."""
         import json as _json
 
-        for _nid, addr in self.servers_of_group(gid):
+        for nid, addr, per_replica in self._iter_replicas(
+            gid, "predlist", timeout
+        ):
             req = urllib.request.Request(f"{addr}/predlist?group={gid}")
             try:
-                with urlopen_peer(req, timeout, self.auth) as resp:
+                with self.peerclient.urlopen(
+                    nid, req, op="predlist",
+                    budget=per_replica, off_timeout=timeout,
+                ) as resp:
                     return list(_json.loads(resp.read()))
             except (urllib.error.HTTPError, OSError):
                 continue
@@ -536,7 +647,7 @@ class ClusterService:
         url = f"{self.peers[peer]}/assign-uids"
         req = urllib.request.Request(url, data=str(n).encode())
         try:
-            with urlopen_peer(req, 10, self.auth) as resp:
+            with self.peerclient.urlopen(peer, req, op="assign", budget=10) as resp:
                 import json
 
                 got = json.loads(resp.read())
@@ -622,6 +733,15 @@ class ClusterStore:
         # stall local reads holding _snap_lock.
         self._remote: Dict[str, list] = {}
         self._predlists: Dict[int, list] = {}
+        # stale-serving bookkeeping: pred -> [gid, last_success_monotonic,
+        # last_stale_serve_monotonic] while the owner is unreachable and
+        # the cached copy is being served.  Entries clear on the next
+        # successful refresh of the predicate, or expire from
+        # degraded_info() once no stale read has been SERVED recently —
+        # a pred that is never queried again must not flag the node
+        # degraded forever after the owner heals.  Guarded by
+        # _remote_lock like the caches it shadows.
+        self._degraded: Dict[str, list] = {}
         self._remote_lock = threading.Lock()  # guards the cache DICTS only
         # per-key fetch locks: one unreachable owner must stall only its
         # own key, not the whole cross-server read plane.  Keys are either
@@ -719,16 +839,25 @@ class ClusterStore:
         """Read a predicate another group owns: versioned snapshot pull
         with a TTL-gated freshness probe.  Serves the cached copy when the
         owner is unreachable (stale reads beat unavailability for the
-        read plane; writes still require the owner's quorum).  Holds only
-        _remote_lock — the network fetch must never stall local reads."""
+        read plane; writes still require the owner's quorum), recording
+        the degradation so responses carry a ``degraded`` annotation.  A
+        reader with NO cached copy raises StaleUnavailableError — the
+        serving layer maps it to 503 + Retry-After / gRPC UNAVAILABLE
+        instead of a raw 500.  Holds only _remote_lock — the network
+        fetch must never stall local reads."""
         import time as _time
 
         from dgraph_tpu.cluster.replica import bytes_to_pred
+        from dgraph_tpu.utils.failpoints import fail
+        from dgraph_tpu.utils.metrics import DEGRADED_READS
 
         with self._remote_lock:
             ent = self._remote.get(pred)
             now = _time.monotonic()
             if ent is not None and now - ent[2] < self.remote_ttl:
+                d = self._degraded.get(pred)
+                if d is not None:
+                    d[2] = now  # this response still serves the stale copy
                 return ent[1]
             flock = self._fetch_locks.setdefault(pred, threading.Lock())
         with flock:  # only THIS predicate's readers wait on the network
@@ -736,27 +865,105 @@ class ClusterStore:
                 ent = self._remote.get(pred)
                 now = _time.monotonic()
                 if ent is not None and now - ent[2] < self.remote_ttl:
+                    d = self._degraded.get(pred)
+                    if d is not None:
+                        d[2] = now
                     return ent[1]  # refreshed while we waited for the lock
             since = ent[0] if ent is not None else -1
             try:
                 ver, payload = self._svc.fetch_pred_snapshot(pred, gid, since)
-            except OSError:
+                # a payload that FAILS TO DECODE degrades the same way an
+                # unreachable owner does: the cached copy outranks an
+                # error (ValueError/IndexError = corrupt frame,
+                # http.client.IncompleteRead = owner died mid-response)
+                fail.point("service.snapshot_decode")
+                pd = ent[1] if payload is None else bytes_to_pred(payload, pred)
+            except (
+                OSError,
+                ValueError,
+                IndexError,
+                http.client.HTTPException,
+            ) as e:
+                if not resilience_enabled() and not isinstance(e, OSError):
+                    # legacy escape hatch is byte-identical to pre-PR:
+                    # only the TRANSPORT class (OSError) fell back to the
+                    # cached copy; a corrupt/truncated frame propagated.
+                    # Serving stale here would mask corruption with both
+                    # the annotation and the counter gated off.
+                    raise
                 if ent is None:
+                    if resilience_enabled():
+                        raise StaleUnavailableError(
+                            f"predicate {pred!r}: owner group {gid} "
+                            "unreachable and no cached snapshot to "
+                            "degrade to",
+                            retry_after=self._svc.peerclient.breaker_cooldown,
+                        ) from e
                     raise
                 with self._remote_lock:
-                    ent[2] = _time.monotonic()  # unreachable: serve stale
+                    now = _time.monotonic()
+                    ent[2] = now  # unreachable: serve stale
+                    if resilience_enabled():
+                        self._degraded[pred] = [gid, ent[3], now]
+                if resilience_enabled():
+                    DEGRADED_READS.add(1)
                 return ent[1]
             changed = ent is not None and payload is not None
-            if payload is None:
-                pd = ent[1]
-            else:
-                pd = bytes_to_pred(payload, pred)
+            now = _time.monotonic()
             with self._remote_lock:
-                self._remote[pred] = [ver, pd, _time.monotonic()]
+                self._remote[pred] = [ver, pd, now, now]
+                self._degraded.pop(pred, None)
         if changed:
             with self._snap_lock:
                 self._dirty.add(pred)  # arenas rebuild from the fresh copy
         return pd
+
+    def degraded_info(self, preds=None) -> Optional[dict]:
+        """The response annotation for stale-served reads: which owner
+        groups are being served from cache, and how old the OLDEST such
+        cache is (seconds since its last successful refresh).  None when
+        nothing is degraded (the overwhelmingly common case).  An entry
+        whose predicate hasn't actually SERVED a stale read recently is
+        expired — stale serves stopped (owner healed, or nobody reads
+        the pred anymore), so the node must stop advertising an outage.
+
+        ``preds`` (gql.ast.referenced_preds, a set — or a zero-arg
+        callable producing one, evaluated only once something IS
+        degraded so the healthy path never pays the AST walk) scopes the
+        answer to the predicates one query can read, so a query that
+        never touches a stale group is not falsely branded degraded;
+        None = node-wide view (the /health surface)."""
+        if not resilience_enabled():
+            return None
+        import time as _time
+
+        with self._remote_lock:
+            if not self._degraded:
+                return None
+        if callable(preds):
+            # the AST walk runs OUTSIDE _remote_lock: during an outage —
+            # exactly when _degraded is non-empty and every response
+            # lands here — holding the lock through it would serialize
+            # the read plane's TTL fast path behind per-query AST walks
+            preds = preds()
+        with self._remote_lock:
+            if not self._degraded:
+                return None
+            now = _time.monotonic()
+            expire = max(5.0, 4.0 * self.remote_ttl)
+            for pred in [
+                p for p, e in self._degraded.items() if now - e[2] > expire
+            ]:
+                del self._degraded[pred]
+            ents = [
+                e for p, e in self._degraded.items()
+                if preds is None or p in preds
+            ]
+            if not ents:
+                return None
+            gids = sorted({e[0] for e in ents})
+            age = max(now - e[1] for e in ents)
+        return {"stale_groups": gids, "age": round(age, 3)}
 
     def _drain_dirty(self) -> None:
         """Caller holds _snap_lock."""

@@ -222,9 +222,28 @@ class _QueuedPeerTransport(Transport):
 
     _thread_prefix = "raft-send"
 
-    def __init__(self, addr_of: Dict[str, str], timeout: float):
+    def __init__(
+        self,
+        addr_of: Dict[str, str],
+        timeout: float,
+        auth: Optional["PeerAuth"] = None,
+        peerclient=None,
+    ):
         self.addr_of = dict(addr_of)      # node_id -> http(s)://host:port
         self.timeout = timeout
+        self.auth = auth
+        # all network sends route through the PeerClient funnel
+        # (cluster/peerclient.py): bounded retries with backoff for
+        # transient errors, and the per-peer breaker turns a dead peer's
+        # frames into microsecond sheds instead of per-frame timeouts.
+        # ClusterService shares ITS client so breaker knowledge is
+        # cluster-wide; standalone transports build their own.  Lazy
+        # import: peerclient imports PeerAuth/urlopen_peer from here.
+        if peerclient is None:
+            from dgraph_tpu.cluster.peerclient import PeerClient
+
+            peerclient = PeerClient(auth=auth)
+        self.peerclient = peerclient
         self._queues: Dict[str, "queue.Queue"] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -270,11 +289,13 @@ class HttpRaftTransport(_QueuedPeerTransport):
         addr_of: Dict[str, str],
         timeout: float = 2.0,
         auth: Optional[PeerAuth] = None,
+        peerclient=None,
     ):
-        super().__init__(addr_of, timeout)
-        self.auth = auth
+        super().__init__(addr_of, timeout, auth=auth, peerclient=peerclient)
 
     def _sender(self, peer: str, q: "queue.Queue") -> None:
+        from dgraph_tpu.utils.metrics import RAFT_DROPPED, note_swallowed
+
         while not self._stop.is_set():
             try:
                 group, body = q.get(timeout=0.5)
@@ -286,9 +307,36 @@ class HttpRaftTransport(_QueuedPeerTransport):
                     url, data=body,
                     headers={"Content-Type": "application/octet-stream"},
                 )
-                urlopen_peer(req, self.timeout, self.auth).read()
-            except OSError:
-                pass  # peer down: drop, heartbeats will retry
+                # bounded retry (2 attempts) through the shared breaker:
+                # a transient blip no longer drops the frame, a dead
+                # peer sheds in microseconds once its circuit opens.
+                # slice_budget=False: halving the (already short) frame
+                # timeout would make a healthy-but-loaded peer answering
+                # in (timeout/2, timeout] fail BOTH slices — frames
+                # dropped and its breaker charged where the legacy
+                # single shot delivered; the first attempt keeps the
+                # legacy window, the retry covers fast failures only
+                with self.peerclient.urlopen(
+                    peer, req, op="raft.send",
+                    budget=self.timeout, attempts=2,
+                    off_timeout=self.timeout, slice_budget=False,
+                ) as resp:
+                    resp.read()
+            except OSError as e:
+                # peer still down after retries: drop (raft re-sends via
+                # the next heartbeat) — but COUNTED, never silent
+                RAFT_DROPPED.add(peer)
+                note_swallowed("transport.http_send", e)
+            except Exception as e:  # noqa: BLE001 — ANY other failure
+                # (IncompleteRead from a peer killed mid-response, encode
+                # surprise) must not kill this peer's only sender thread
+                # for the process lifetime; same discipline as the gRPC
+                # twin: count under its own site AND print the traceback
+                import traceback
+
+                RAFT_DROPPED.add(peer)
+                note_swallowed("transport.sender_unexpected", e)
+                traceback.print_exc()
 
 
 def grpc_target_of(http_addr: str, port_offset: int) -> str:
@@ -329,11 +377,11 @@ class GrpcRaftTransport(_QueuedPeerTransport):
         secret: str = "",
         port_offset: int = 1000,
         auth: Optional[PeerAuth] = None,
+        peerclient=None,
     ):
-        super().__init__(addr_of, timeout)
+        super().__init__(addr_of, timeout, auth=auth, peerclient=peerclient)
         self.secret = secret
         self.port_offset = port_offset
-        self.auth = auth
         for a in self.addr_of.values():
             self._check_addr(a)
         self._chans: Dict[str, object] = {}  # target -> channel
@@ -397,11 +445,9 @@ class GrpcRaftTransport(_QueuedPeerTransport):
             encode_payload,
             frame_raft,
         )
-        from dgraph_tpu.utils.metrics import note_swallowed
+        from dgraph_tpu.utils.metrics import RAFT_DROPPED, note_swallowed
 
         md = [(_SECRET_MD, self.secret)] if self.secret else None
-        cur_addr = None
-        rpc = None
         while not self._stop.is_set():
             try:
                 group, body = q.get(timeout=0.5)
@@ -409,26 +455,40 @@ class GrpcRaftTransport(_QueuedPeerTransport):
                 continue
             try:
                 # re-resolve per message (like HttpRaftTransport): a
-                # member re-announcing on a new address rebinds the rpc
+                # member re-announcing on a new address routes the next
+                # frame to the new target
                 addr = self.addr_of.get(peer)
                 if addr is None:
                     continue
-                if addr != cur_addr or rpc is None:
-                    rpc = self._channel_for(addr).unary_unary(
-                        "/protos.Worker/RaftMessage"
-                    )
-                    cur_addr = addr
                 payload = encode_payload(frame_raft(group, body))
+                # the channel-RPC itself runs inside PeerClient (its
+                # grpc_unary leg): bounded retries, breaker sheds, and
+                # the ValueError a closing channel throws mid-call is
+                # classified transient there — a ValueError out of
+                # encode_payload above still reaches the unexpected
+                # handler below, as before
                 try:
-                    rpc(payload, timeout=self.timeout, metadata=md)
+                    # slice_budget=False for the same reason as the HTTP
+                    # twin: a loaded peer answering within the legacy
+                    # window must not fail two half-window slices
+                    self.peerclient.grpc_unary(
+                        peer, "raft.send", self._channel_for(addr),
+                        "/protos.Worker/RaftMessage", payload,
+                        metadata=md, budget=self.timeout, attempts=2,
+                        slice_budget=False,
+                    )
                 except ValueError as e:
-                    # the channel closed under us mid-call; scoped to
-                    # the rpc ONLY — a ValueError out of encode_payload
-                    # is a bug and must reach the unexpected handler
+                    # the RESILIENCE=0 off-path returns the raw attempt,
+                    # so the closed-channel ValueError arrives HERE
+                    # instead of wrapped transient inside peerclient:
+                    # same quiet counted drop as any peer-down error,
+                    # not a per-frame traceback
+                    RAFT_DROPPED.add(peer)
                     note_swallowed("transport.grpc_send", e)
             except (grpc.RpcError, OSError) as e:
-                # peer down: drop, heartbeats will retry — but a peer
-                # that stays down shows up as a counter rate
+                # peer still down after retries: drop (heartbeats will
+                # re-send) — counted, never silent
+                RAFT_DROPPED.add(peer)
                 note_swallowed("transport.grpc_send", e)
             except Exception as e:  # noqa: BLE001 — ANY other failure
                 # (encode bug, channel-construction surprise) must not

@@ -19,7 +19,13 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from dgraph_tpu import gql, ops
-from dgraph_tpu.gql.ast import FilterTree, Function, GraphQuery, MathTree
+from dgraph_tpu.gql.ast import (
+    FilterTree,
+    Function,
+    GraphQuery,
+    MathTree,
+    referenced_preds,
+)
 from dgraph_tpu.models.arena import ArenaManager
 from dgraph_tpu.models.store import PostingStore
 from dgraph_tpu.models.types import TypeID, TypedValue, numeric, sort_key
@@ -372,6 +378,21 @@ class QueryEngine:
             out["schema"] = self._schema_response(parsed.schema_request)
         if parsed.queries:
             out.update(self.execute(parsed))
+            # graceful degradation (ClusterStore.degraded_info): when any
+            # owner group's snapshots are being served from cache because
+            # the owners are unreachable, the response says so — clients
+            # see stale-but-correct data WITH a freshness disclosure
+            # instead of an error page (JSON extension; gRPC mirrors it
+            # as a dgraph-degraded trailer, serve/grpc_server.py).
+            # Scoped to the predicates THIS query can read (None = not
+            # statically knowable, e.g. expand(): node-wide view) so a
+            # purely-local query is never branded stale.  Passed as a
+            # thunk: the AST walk only runs when something IS degraded
+            deg = getattr(self.store, "degraded_info", None)
+            if deg is not None:
+                info = deg(preds=lambda: referenced_preds(parsed.queries))
+                if info:
+                    out["degraded"] = info
         elif parsed.mutation is not None and "schema" not in out:
             out["code"] = "Success"
             out["message"] = "Done"

@@ -70,6 +70,8 @@ from dgraph_tpu.sched.cohort import (
     SchedRequest,
     hop_signature,
 )
+from dgraph_tpu.utils.env import env_float as _env_f
+from dgraph_tpu.utils.failpoints import fail
 from dgraph_tpu.utils.metrics import (
     SCHED_COALESCED,
     SCHED_COHORT_OCCUPANCY,
@@ -83,13 +85,6 @@ from dgraph_tpu.utils.metrics import (
 def sched_enabled() -> bool:
     """The DGRAPH_TPU_SCHED gate (default ON)."""
     return os.environ.get("DGRAPH_TPU_SCHED", "1") != "0"
-
-
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
 
 
 class CohortScheduler:
@@ -351,6 +346,10 @@ class CohortScheduler:
         merger = HopMerger(len(leaders), window_s=self.merge_window_s)
         srv = self._server
         try:
+            # chaos hook (utils/failpoints.py): an injected flush fault
+            # lands INSIDE the try, so every member fails cleanly through
+            # req.fail below instead of killing the worker loop
+            fail.point("sched.flush")
             with srv._engine_lock.read():  # ONE read acquisition per cohort
                 if len(leaders) == 1:
                     self._run_one(leaders[0], merger)

@@ -465,12 +465,17 @@ class GrpcServer:
             out = self._server.run_query(text, vars_ or None,
                                          timeout_s=timeout_s)
         except Exception as e:
+            from dgraph_tpu.cluster.peerclient import StaleUnavailableError
             from dgraph_tpu.sched import SchedDeadlineError, SchedOverloadError
 
             if isinstance(e, SchedOverloadError):
                 context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
             if isinstance(e, SchedDeadlineError):
                 context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+            if isinstance(e, StaleUnavailableError):
+                # owner group unreachable with no cached copy: retriable
+                # service condition (the HTTP surface's 503 + Retry-After)
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             # isinstance, not a name list: every client-input error in
             # the tree subclasses ValueError (gql.ParseError,
             # rdf.ParseError, QueryError) — the old exact-name check
@@ -482,6 +487,16 @@ class GrpcServer:
                 else grpc.StatusCode.INTERNAL
             )
             context.abort(code, str(e))
+        deg = out.get("degraded")
+        if deg:
+            # stale-read disclosure: the proto Response has no field for
+            # it (graphresponse.proto is frozen), so it rides a trailer —
+            # same shape as the JSON extension
+            import json as _json
+
+            context.set_trailing_metadata(
+                (("dgraph-degraded", _json.dumps(deg)),)
+            )
         return _p.encode_response(out)
 
     def _check(self, req: bytes, context):

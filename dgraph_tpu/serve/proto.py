@@ -179,6 +179,10 @@ def _is_meta(k: str, v: Any) -> bool:
         return True
     if k == "uids":
         return isinstance(v, dict)
+    if k == "degraded":
+        # stale-read disclosure (resilience layer): metadata, not a
+        # result block — gRPC carries it as a trailer instead
+        return isinstance(v, dict)
     if k in ("code", "message"):
         return isinstance(v, str)
     return k == "schema"

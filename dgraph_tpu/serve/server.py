@@ -32,6 +32,7 @@ from dgraph_tpu.utils.metrics import (
     QUERY_LATENCY,
     metrics,
 )
+from dgraph_tpu.cluster.peerclient import StaleUnavailableError
 from dgraph_tpu.sched import (
     SchedDeadlineError,
     SchedOverloadError,
@@ -364,11 +365,19 @@ def _make_handler(srv: DgraphServer):
         def log_message(self, *a):  # quiet
             pass
 
-        def _reply(self, code: int, body: bytes, ctype: str = "application/json"):
+        def _reply(
+            self,
+            code: int,
+            body: bytes,
+            ctype: str = "application/json",
+            extra_headers=None,
+        ):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             for k, v in _CORS.items():
+                self.send_header(k, v)
+            for k, v in (extra_headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
@@ -386,7 +395,18 @@ def _make_handler(srv: DgraphServer):
             u = urlparse(self.path)
             path = u.path
             if path == "/health":
-                if srv.health.ok():
+                qs = parse_qs(u.query)
+                if qs.get("detail", ["0"])[0] in ("1", "true"):
+                    # peer/breaker/raft-leader summary (resilience layer,
+                    # cluster/peerclient.py).  The bare /health stays a
+                    # plain OK/503 — load balancers and the dashboard
+                    # only want the bit.
+                    detail = {"ok": srv.health.ok()}
+                    if srv.cluster is not None:
+                        detail.update(srv.cluster.health_summary())
+                    code = 200 if srv.health.ok() else 503
+                    self._reply(code, json.dumps(detail).encode())
+                elif srv.health.ok():
                     self._reply(200, b"OK", "text/plain")
                 else:
                     self._reply(503, b"\"uninitialized\"")
@@ -433,8 +453,6 @@ def _make_handler(srv: DgraphServer):
                     return self._err(404, "not clustered")
                 if not self._cluster_authorized():
                     return self._err(403, "cluster secret required")
-                from urllib.parse import parse_qs
-
                 qs = parse_qs(u.query)  # parse_qs already percent-decodes
                 name = qs.get("name", [""])[0]
                 since = int(qs.get("since", ["-1"])[0])
@@ -458,8 +476,6 @@ def _make_handler(srv: DgraphServer):
                     return self._err(404, "not clustered")
                 if not self._cluster_authorized():
                     return self._err(403, "cluster secret required")
-                from urllib.parse import parse_qs
-
                 gid = int(parse_qs(u.query).get("group", ["-1"])[0])
                 g = srv.cluster.groups.get(gid)
                 if g is None:
@@ -594,6 +610,23 @@ def _make_handler(srv: DgraphServer):
                     self._reply(504, json.dumps(
                         {"code": "ErrorDeadlineExceeded", "message": str(e)}
                     ).encode())
+                except StaleUnavailableError as e:
+                    # owner group unreachable AND no cached snapshot to
+                    # degrade to: a retriable SERVICE condition, told as
+                    # one — 503 + Retry-After sized to the breaker
+                    # cooldown, not a raw 400/500
+                    self._reply(
+                        503,
+                        json.dumps({
+                            "code": "ErrorServiceUnavailable",
+                            "message": str(e),
+                        }).encode(),
+                        extra_headers={
+                            "Retry-After": str(
+                                max(1, int(round(e.retry_after)))
+                            )
+                        },
+                    )
                 except Exception as e:
                     self._err(400, str(e))
             elif u.path == "/share":

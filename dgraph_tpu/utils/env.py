@@ -1,0 +1,18 @@
+"""Shared environment-variable parsing for tuning knobs.
+
+Every subsystem with env-tunable numbers (scheduler flush timing,
+peer-RPC retry/breaker knobs) parses them the same way: a float with a
+default, where an unparsable value falls back to the default instead of
+crashing process startup over a typo'd knob.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default

@@ -1,0 +1,167 @@
+"""Named failpoints: deterministic fault injection for chaos tests.
+
+The reference project grew its fault-tolerance story by killing real
+processes (testrun.sh restart loops); that finds bugs but cannot
+*reproduce* them.  This registry is the gofail/failpoint analog: code
+sites call ``fail.point("peerclient.forward")`` — a dict probe that
+compiles to a near-no-op while nothing is armed — and tests (or the
+``DGRAPH_TPU_FAILPOINTS`` env var) arm actions against those names:
+
+    DGRAPH_TPU_FAILPOINTS="peerclient.snapshot=error(p=0.5,n=3);sched.flush=delay(ms=200)"
+    DGRAPH_TPU_FAILPOINT_SEED=42
+
+Actions:
+
+- ``error(p=, n=, ms=)`` — raise :class:`FailpointError` (an ``OSError``
+  subclass, so every transient-network-failure path treats an injected
+  fault exactly like a real one).  Optional ``ms`` sleeps first, which
+  models a peer that *stalls* before failing (the expensive failure mode
+  — a connect timeout, not a connect refusal).
+- ``delay(ms=, p=, n=)`` — sleep without failing (slow peer / GC pause).
+
+``p`` is the trigger probability (default 1.0), ``n`` caps how many
+times the action fires (default unlimited).  All probability draws come
+from ONE seeded RNG (``DGRAPH_TPU_FAILPOINT_SEED``, default 0), so a
+chaos run replays bit-identically: same seed + same call order = same
+faults.  Triggers are counted per site in
+``dgraph_failpoints_fired_total{site=...}`` and via :meth:`hits`.
+
+Instrumented sites (grep ``fail.point``): every PeerClient attempt
+(``peerclient.<op>`` — forward, snapshot, predlist, assign, join,
+raft.send), snapshot decode (``service.snapshot_decode``), and the
+cohort scheduler's flush (``sched.flush``).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+import threading
+import time
+from typing import Dict, Optional
+
+
+class FailpointError(OSError):
+    """An injected fault.  OSError on purpose: resilience code must not
+    be able to tell an injected failure from a real network one."""
+
+
+_ACTION_RE = re.compile(r"^(error|delay)\s*(?:\((.*)\))?$")
+
+
+class _Action:
+    __slots__ = ("kind", "p", "n", "ms")
+
+    def __init__(self, kind: str, p: float = 1.0, n: int = -1, ms: float = 0.0):
+        self.kind = kind
+        self.p = p
+        self.n = n          # remaining fires; -1 = unlimited
+        self.ms = ms
+
+    @classmethod
+    def parse(cls, spec: str) -> "_Action":
+        m = _ACTION_RE.match(spec.strip())
+        if not m:
+            raise ValueError(f"bad failpoint action {spec!r}")
+        kind, args = m.group(1), m.group(2) or ""
+        kw: Dict[str, float] = {}
+        for part in args.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, _, v = part.partition("=")
+            k = k.strip()
+            if k not in ("p", "n", "ms"):
+                raise ValueError(f"bad failpoint param {k!r} in {spec!r}")
+            kw[k] = float(v)
+        return cls(
+            kind,
+            p=float(kw.get("p", 1.0)),
+            n=int(kw.get("n", -1)),
+            ms=float(kw.get("ms", 0.0)),
+        )
+
+
+class Failpoints:
+    """The registry.  One process-global instance (``fail``) is the
+    normal entry point; tests may build private ones."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._armed: Dict[str, _Action] = {}
+        self._hits: Dict[str, int] = {}
+        self._rng = random.Random(seed)
+
+    # -- configuration ------------------------------------------------------
+
+    def seed(self, s: int) -> None:
+        with self._lock:
+            self._rng = random.Random(s)
+
+    def arm(self, site: str, action: str) -> None:
+        act = _Action.parse(action)
+        with self._lock:
+            self._armed[site] = act
+
+    def disarm(self, site: str) -> None:
+        with self._lock:
+            self._armed.pop(site, None)
+
+    def reset(self, seed: int = 0) -> None:
+        """Disarm everything and reseed — test teardown."""
+        with self._lock:
+            self._armed.clear()
+            self._hits.clear()
+            self._rng = random.Random(seed)
+
+    def configure(self, spec: str, seed: Optional[int] = None) -> None:
+        """``site=action;site2=action`` (the env-var grammar)."""
+        if seed is not None:
+            self.seed(seed)
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            site, _, action = part.partition("=")
+            if not action:
+                raise ValueError(f"bad failpoint entry {part!r} (want site=action)")
+            self.arm(site.strip(), action)
+
+    # -- the probe ----------------------------------------------------------
+
+    def point(self, site: str) -> None:
+        """Fire the armed action for ``site``, if any.  The disarmed fast
+        path is one dict-emptiness check — safe on every hot path."""
+        if not self._armed:
+            return
+        with self._lock:
+            act = self._armed.get(site)
+            if act is None:
+                return
+            if act.n == 0:
+                return
+            if act.p < 1.0 and self._rng.random() >= act.p:
+                return
+            if act.n > 0:
+                act.n -= 1
+            self._hits[site] = self._hits.get(site, 0) + 1
+            kind, ms = act.kind, act.ms
+        from dgraph_tpu.utils.metrics import FAILPOINTS_FIRED
+
+        FAILPOINTS_FIRED.add(site)
+        if ms > 0:
+            time.sleep(ms / 1000.0)
+        if kind == "error":
+            raise FailpointError(f"failpoint {site!r} injected error")
+
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+
+fail = Failpoints(seed=int(os.environ.get("DGRAPH_TPU_FAILPOINT_SEED", "0")))
+
+_env_spec = os.environ.get("DGRAPH_TPU_FAILPOINTS", "")
+if _env_spec:
+    fail.configure(_env_spec)

@@ -71,6 +71,64 @@ class LabeledCounter:
             return dict(self._m)
 
 
+class MultiLabeledCounter:
+    """Counter family keyed by a label TUPLE — the resilience layer needs
+    ``dgraph_peer_rpc_total{peer,op,outcome}``, and packing three axes
+    into one string label would make per-axis aggregation in Prometheus
+    impossible."""
+
+    def __init__(self, name: str, labels):
+        self.name = name
+        self.labels = tuple(labels)
+        self._m: Dict[tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def add(self, key, n: int = 1) -> None:
+        key = tuple(str(k) for k in key)
+        if len(key) != len(self.labels):
+            raise ValueError(
+                f"{self.name}: expected {len(self.labels)} label values, "
+                f"got {len(key)}"
+            )
+        with self._lock:
+            self._m[key] = self._m.get(key, 0) + n
+
+    def snapshot(self) -> Dict[tuple, int]:
+        with self._lock:
+            return dict(self._m)
+
+    def total(self, **want) -> int:
+        """Sum over series matching the given label=value filters."""
+        idx = {l: i for i, l in enumerate(self.labels)}
+        out = 0
+        for key, v in self.snapshot().items():
+            if all(key[idx[l]] == str(val) for l, val in want.items()):
+                out += v
+        return out
+
+
+class LabeledGauge:
+    """Gauge family keyed by one label (per-peer breaker state)."""
+
+    def __init__(self, name: str, label: str):
+        self.name = name
+        self.label = label
+        self._m: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, key: str, v: float) -> None:
+        with self._lock:
+            self._m[key] = v
+
+    def value(self, key: str) -> float:
+        with self._lock:
+            return self._m.get(key, 0.0)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._m)
+
+
 class Histogram:
     """Fixed-bucket histogram with Prometheus `_bucket{le=...}` / `_sum` /
     `_count` exposition (the prometheus client_golang Histogram shape; the
@@ -126,6 +184,8 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._labeled: Dict[str, LabeledCounter] = {}
+        self._multilabeled: Dict[str, MultiLabeledCounter] = {}
+        self._labeled_gauges: Dict[str, LabeledGauge] = {}
         self._histograms: Dict[str, Histogram] = {}
 
     def counter(self, name: str) -> Counter:
@@ -149,6 +209,20 @@ class MetricsRegistry:
                 l = self._labeled[name] = LabeledCounter(name, label)
             return l
 
+    def multilabeled(self, name: str, labels) -> MultiLabeledCounter:
+        with self._lock:
+            c = self._multilabeled.get(name)
+            if c is None:
+                c = self._multilabeled[name] = MultiLabeledCounter(name, labels)
+            return c
+
+    def labeled_gauge(self, name: str, label: str) -> LabeledGauge:
+        with self._lock:
+            g = self._labeled_gauges.get(name)
+            if g is None:
+                g = self._labeled_gauges[name] = LabeledGauge(name, label)
+            return g
+
     def histogram(self, name: str, buckets) -> Histogram:
         with self._lock:
             h = self._histograms.get(name)
@@ -164,7 +238,13 @@ class MetricsRegistry:
             counters = list(self._counters.values())
             gauges = list(self._gauges.values())
             labeled = list(self._labeled.values())
+            multilabeled = list(self._multilabeled.values())
+            labeled_gauges = list(self._labeled_gauges.values())
             histograms = list(self._histograms.values())
+
+        def _esc(s: str) -> str:
+            return s.replace("\\", "\\\\").replace('"', '\\"')
+
         for c in sorted(counters, key=lambda c: c.name):
             lines.append(f"# TYPE {c.name} counter")
             lines.append(f"{c.name} {c.value()}")
@@ -174,8 +254,18 @@ class MetricsRegistry:
         for l in sorted(labeled, key=lambda l: l.name):
             lines.append(f"# TYPE {l.name} counter")
             for k, v in sorted(l.snapshot().items()):
-                esc = k.replace("\\", "\\\\").replace('"', '\\"')
-                lines.append(f'{l.name}{{{l.label}="{esc}"}} {v}')
+                lines.append(f'{l.name}{{{l.label}="{_esc(k)}"}} {v}')
+        for ml in sorted(multilabeled, key=lambda m: m.name):
+            lines.append(f"# TYPE {ml.name} counter")
+            for key, v in sorted(ml.snapshot().items()):
+                pairs = ",".join(
+                    f'{lab}="{_esc(val)}"' for lab, val in zip(ml.labels, key)
+                )
+                lines.append(f"{ml.name}{{{pairs}}} {v}")
+        for lg in sorted(labeled_gauges, key=lambda g: g.name):
+            lines.append(f"# TYPE {lg.name} gauge")
+            for k, v in sorted(lg.snapshot().items()):
+                lines.append(f'{lg.name}{{{lg.label}="{_esc(k)}"}} {v:g}')
         for h in sorted(histograms, key=lambda h: h.name):
             cum, s, c = h.snapshot()
             lines.append(f"# TYPE {h.name} histogram")
@@ -254,6 +344,40 @@ QCACHE_HIT_AGE = metrics.histogram(
 # operator can alert on, instead of as silence.
 SWALLOWED_EXC = metrics.labeled(
     "dgraph_swallowed_exceptions_total", label="site"
+)
+
+
+# resilience layer (cluster/peerclient.py, utils/failpoints.py): every
+# peer RPC lands in PEER_RPC as {peer, op, outcome} — outcome "ok",
+# "http_error" (peer responded with an application error: alive),
+# "unavailable" (retries/budget exhausted), "open" (shed by the circuit
+# breaker without touching the network).  Alert on the unavailable/open
+# rate per peer; BREAKER_STATE is the at-a-glance gauge (0 closed,
+# 1 half-open, 2 open), one series per "peer:op" because breakers are
+# scoped per (peer, op) — a broken snapshot endpoint must stay visible
+# while raft heartbeats to the same peer succeed.
+PEER_RPC = metrics.multilabeled(
+    "dgraph_peer_rpc_total", ("peer", "op", "outcome")
+)
+PEER_RPC_ATTEMPTS = metrics.histogram(
+    "dgraph_peer_rpc_attempts", (1, 2, 3, 4, 6, 8)
+)
+PEER_BACKOFF = metrics.histogram(
+    "dgraph_peer_backoff_seconds",
+    (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0),
+)
+BREAKER_STATE = metrics.labeled_gauge(
+    "dgraph_peer_breaker_state", label="peer"
+)
+BREAKER_TRANSITIONS = metrics.multilabeled(
+    "dgraph_peer_breaker_transitions_total", ("peer", "op", "to")
+)
+DEGRADED_READS = metrics.counter("dgraph_degraded_reads_total")
+RAFT_DROPPED = metrics.labeled(
+    "dgraph_raft_frames_dropped_total", label="peer"
+)
+FAILPOINTS_FIRED = metrics.labeled(
+    "dgraph_failpoints_fired_total", label="site"
 )
 
 

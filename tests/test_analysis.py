@@ -17,6 +17,7 @@ from dgraph_tpu.analysis.lockorder import build_lock_graph, check_lock_order
 from dgraph_tpu.analysis.rules import (
     ALL_RULES,
     HostSyncInJit,
+    NakedPeerRpc,
     RecompileHazard,
     SwallowedException,
     WallClockDuration,
@@ -233,6 +234,62 @@ def test_swallowed_broad_except_pass_flagged():
     ) == ["swallowed-exception"]
 
 
+def test_naked_peer_rpc_urlopen_peer_flagged_anywhere():
+    src = textwrap.dedent("""
+        from dgraph_tpu.cluster.transport import urlopen_peer
+
+        def fetch(req, auth):
+            with urlopen_peer(req, 5, auth) as resp:
+                return resp.read()
+    """)
+    assert _ids(
+        check_source(src, [NakedPeerRpc()], path="dgraph_tpu/serve/foo.py")
+    ) == ["naked-peer-rpc"]
+
+
+def test_naked_peer_rpc_channel_call_flagged_in_cluster():
+    src = textwrap.dedent("""
+        def send(channel, payload):
+            rpc = channel.unary_unary("/protos.Worker/RaftMessage")
+            return rpc(payload, timeout=2.0)
+    """)
+    assert _ids(
+        check_source(
+            src, [NakedPeerRpc()], path="dgraph_tpu/cluster/newtransport.py"
+        )
+    ) == ["naked-peer-rpc"]
+
+
+def test_naked_peer_rpc_clean_counterexamples():
+    # the funnel itself is the one legitimate home of both call forms
+    inside = textwrap.dedent("""
+        def call(self, req, channel, payload, auth):
+            with urlopen_peer(req, 5, auth) as resp:
+                resp.read()
+            return channel.unary_unary("/m")(payload)
+    """)
+    assert check_source(
+        inside, [NakedPeerRpc()], path="dgraph_tpu/cluster/peerclient.py"
+    ) == []
+    # routing THROUGH the funnel is clean anywhere
+    routed = textwrap.dedent("""
+        def forward(self, peer, req):
+            with self.peerclient.urlopen(peer, req, op="forward", budget=5) as r:
+                return r.read()
+    """)
+    assert check_source(
+        routed, [NakedPeerRpc()], path="dgraph_tpu/cluster/service.py"
+    ) == []
+    # a raw channel RPC on the PUBLIC client surface is out of scope
+    client_side = textwrap.dedent("""
+        def probe(channel):
+            return channel.unary_unary("/protos.Dgraph/CheckVersion")(b"")
+    """)
+    assert check_source(
+        client_side, [NakedPeerRpc()], path="dgraph_tpu/serve/grpc_server.py"
+    ) == []
+
+
 def test_swallowed_narrow_or_counted_not_flagged():
     src = textwrap.dedent("""
         def f():
@@ -410,6 +467,10 @@ _CLI_BAD = {
     ),
     "swallowed-exception": (
         "def f():\n    try:\n        g()\n    except Exception:\n        pass\n"
+    ),
+    "naked-peer-rpc": (
+        "from dgraph_tpu.cluster.transport import urlopen_peer\n\n"
+        "def f(req, auth):\n    return urlopen_peer(req, 5, auth)\n"
     ),
 }
 
