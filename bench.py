@@ -640,6 +640,192 @@ def run_serving_bench():
     }
 
 
+def _mutation_mode(
+    group_commit: bool, clients: int, secs: float, tmp: str,
+    fsync_ms: float = 0.0,
+):
+    """One closed-loop durable-mutation run: ``clients`` threads fire
+    single-edge mutations against a fresh DgraphServer over a fresh
+    --sync DurableStore (fsync-per-acknowledged-write contract).
+    ``group_commit`` flips DGRAPH_TPU_GROUP_COMMIT — the ISSUE 6 A/B:
+    per-write fsync inside the write lock vs one shared fsync per convoy
+    of concurrent writers.  ``fsync_ms`` > 0 models a production disk by
+    arming ``wal.post_flush=delay(ms=...)`` (the failpoint fires inside
+    the fsync critical section, so the per-write arm serializes behind
+    it while the group-commit convoy shares one delay — same mechanism,
+    calibrated medium).  Returns (writes/s, p99_ms, writes, fsyncs)."""
+    import json as _json
+    import threading
+
+    os.environ["DGRAPH_TPU_GROUP_COMMIT"] = "1" if group_commit else "0"
+    os.environ["DGRAPH_TPU_SNAPSHOTTER"] = "0"  # isolate the fsync cost
+    from dgraph_tpu.models.wal import DurableStore
+    from dgraph_tpu.serve.server import DgraphServer
+    from dgraph_tpu.utils.failpoints import fail
+    from dgraph_tpu.utils.metrics import (
+        GROUP_COMMIT_SYNCS,
+        GROUP_COMMIT_WRITES,
+    )
+
+    if fsync_ms > 0:
+        fail.arm("wal.post_flush", f"delay(ms={fsync_ms:g})")
+    store = DurableStore(
+        os.path.join(tmp, "gc1" if group_commit else "gc0"),
+        sync_writes=True,
+    )
+    srv = DgraphServer(store)
+    srv.start()
+    try:
+        import http.client
+
+        def post_on(conn, q):
+            conn.request("POST", "/query", body=q.encode())
+            r = conn.getresponse()
+            body = r.read()
+            if r.status != 200:
+                raise RuntimeError(f"HTTP {r.status}: {body[:200]!r}")
+            return _json.loads(body.decode())
+
+        warm = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+        post_on(warm, "mutation { schema { bm: string . } }")
+        warm.close()
+        w0 = GROUP_COMMIT_WRITES.value()
+        s0 = GROUP_COMMIT_SYNCS.value()
+        lat_lock = threading.Lock()
+        lats: list = []
+        errs: list = []
+        stop_at = [time.monotonic() + 3600]
+
+        def client(cid: int):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", srv.port, timeout=30
+            )
+            my = []
+            uid = (cid + 1) << 24  # disjoint uid ranges per writer
+            try:
+                while time.monotonic() < stop_at[0]:
+                    uid += 1
+                    t0 = time.monotonic()
+                    post_on(
+                        conn,
+                        'mutation { set { <0x%x> <bm> "x" . } }' % uid,
+                    )
+                    my.append(time.monotonic() - t0)
+            except Exception as e:
+                errs.append(e)
+            finally:
+                conn.close()
+            with lat_lock:
+                lats.extend(my)
+
+        ts = [
+            threading.Thread(target=client, args=(c,), daemon=True)
+            for c in range(clients)
+        ]
+        stop_at[0] = time.monotonic() + secs
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=secs + 60)
+        wall = time.monotonic() - t0
+        if errs:
+            raise errs[0]
+        if not lats:
+            raise RuntimeError("mutation bench made no writes")
+        a = np.sort(np.asarray(lats))
+        return (
+            len(a) / wall,
+            float(a[int(0.99 * (len(a) - 1))]) * 1e3,
+            GROUP_COMMIT_WRITES.value() - w0,
+            GROUP_COMMIT_SYNCS.value() - s0,
+        )
+    finally:
+        srv.stop()
+        if fsync_ms > 0:
+            fail.disarm("wal.post_flush")
+        os.environ.pop("DGRAPH_TPU_GROUP_COMMIT", None)
+        os.environ.pop("DGRAPH_TPU_SNAPSHOTTER", None)
+
+
+def run_mutation_bench():
+    """Durable-write A/B (ISSUE 6): --sync mutation throughput with
+    concurrent writers, group commit on vs per-write fsync.  Interleaved
+    reps + medians, same discipline as the serving bench.  The
+    ``fsync_share`` line is the amortization factor the metrics pair
+    (dgraph_group_commit_{writes,syncs}_total) exposes in production."""
+    import shutil
+    import tempfile
+    from statistics import median
+
+    clients = int(os.environ.get("BENCH_MUT_CLIENTS", 8))
+    secs = float(os.environ.get("BENCH_MUT_SECONDS", 2.0))
+    reps = max(1, int(os.environ.get("BENCH_MUT_REPS", 2)))
+    # modeled-disk arm: a calibrated fsync latency (EBS/network media
+    # run 5-30ms; local NVMe 0.5-3ms).  This CPU container's page-cache
+    # fsync is so cheap the exclusive engine section dominates both
+    # arms — the modeled arm shows the mechanism at production fsync
+    # cost.  0 disables.  (Measured here at 15ms/8 writers: ~2.9x and
+    # fsync_share ~2.4, capped by the 2-core host's GIL-contended
+    # engine section, not by the commit protocol.)
+    fsync_ms = float(os.environ.get("BENCH_MUT_FSYNC_MS", 15.0))
+    tmp = tempfile.mkdtemp(prefix="dgraph-bench-mut-")
+
+    def _arm_pair(sub: str, ms: float):
+        on_runs, off_runs = [], []
+        writes = syncs = 0
+        for r in range(reps):
+            d = os.path.join(tmp, f"{sub}-r{r}")
+            os.makedirs(d, exist_ok=True)
+            wps, p99, w, s = _mutation_mode(
+                True, clients, secs, d, fsync_ms=ms
+            )
+            on_runs.append((wps, p99))
+            writes += w
+            syncs += s
+            wps, p99, _w, _s = _mutation_mode(
+                False, clients, secs, d, fsync_ms=ms
+            )
+            off_runs.append((wps, p99))
+        wps_on = median(x[0] for x in on_runs)
+        wps_off = median(x[0] for x in off_runs)
+        return {
+            "group_commit": {
+                "writes_per_sec": round(wps_on, 1),
+                "p99_ms": round(median(x[1] for x in on_runs), 2),
+            },
+            "per_write_fsync": {
+                "writes_per_sec": round(wps_off, 1),
+                "p99_ms": round(median(x[1] for x in off_runs), 2),
+            },
+            # the ISSUE 6 headline: durable writes/s, shared fsync over
+            # fsync-per-acknowledged-write, same writer fleet
+            "group_commit_ratio": (
+                round(wps_on / wps_off, 3) if wps_off else None
+            ),
+            # >1 = convoys actually shared fsyncs (writes per fsync,
+            # group-commit arm only)
+            "fsync_share": round(writes / max(syncs, 1), 2),
+        }
+
+    try:
+        out = {
+            "clients": clients,
+            "seconds": secs,
+            "reps": reps,
+            "sync": True,
+            "real_disk": _arm_pair("real", 0.0),
+        }
+        if fsync_ms > 0:
+            out["modeled_disk"] = {
+                "fsync_ms": fsync_ms,
+                **_arm_pair("model", fsync_ms),
+            }
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_bench(scale: float):
     import jax
 
@@ -711,6 +897,14 @@ def run_bench(scale: float):
             serving = run_serving_bench()
         except Exception as e:
             serving = {"error": f"{type(e).__name__}: {e}"}
+    durability = None
+    if os.environ.get("BENCH_MUT", "1") != "0":
+        # durable-mutation A/B (group commit vs per-write fsync); same
+        # isolation contract as the serving arm
+        try:
+            durability = run_mutation_bench()
+        except Exception as e:
+            durability = {"error": f"{type(e).__name__}: {e}"}
     print(
         json.dumps(
             {
@@ -721,6 +915,9 @@ def run_bench(scale: float):
                 # multi-client serving A/B (BENCH_SERVE=0 skips;
                 # BENCH_CLIENTS / BENCH_SERVE_SECONDS size it)
                 "serving": serving,
+                # durable-mutation A/B (BENCH_MUT=0 skips;
+                # BENCH_MUT_CLIENTS / BENCH_MUT_SECONDS size it)
+                "durability": durability,
                 # self-describing record: a wedged-TPU round falls back to
                 # XLA-on-CPU (see ensure_backend) and must not read as a
                 # TPU measurement

@@ -28,6 +28,12 @@ on a noisy 2-core CPU host:
   retry budget, circuit breaker and health ordering — exactly the
   one-shot brittleness PR 5 removed; route it through
   ``cluster/peerclient.py``.
+- ``naked-atomic-write``: a direct ``os.replace``/``os.rename`` outside
+  ``utils/atomicio.py`` — durable file replacement must go through
+  ``atomic_write_file`` (tmp + fsync + replace + directory fsync) or a
+  crash can observe a half-state or resurrect the old name.  The rare
+  deliberate site (a rename of an already-fully-synced file, a build
+  artifact) carries the pragma with a WHY comment.
 
 Suppress a deliberate site with ``# graftlint: ignore[rule-id]`` on the
 line (or the line above).  docs/analysis.md has the full catalog and
@@ -535,10 +541,68 @@ class NakedPeerRpc(Rule):
                 )
 
 
+# -- rule: naked-atomic-write -----------------------------------------------
+
+_RENAME_FNS = {"replace", "rename", "renames"}
+
+
+def _os_rename_aliases(tree: ast.AST) -> Set[str]:
+    """Bare names that mean os.replace/os.rename in this file
+    (``from os import replace [as rp]``)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "os":
+            for a in node.names:
+                if a.name in _RENAME_FNS:
+                    out.add(a.asname or a.name)
+    return out
+
+
+class NakedAtomicWrite(Rule):
+    id = "naked-atomic-write"
+    doc = (
+        "direct os.replace / os.rename outside utils/atomicio.py — "
+        "durable file replacement must go through atomic_write_file "
+        "(tmp + fsync + replace + dir fsync) or a crash can observe "
+        "half-state"
+    )
+
+    # every step of the dance matters: a replace without the tmp-fsync
+    # can install a file whose BLOCKS are not on disk yet (content
+    # garbage after a crash); without the directory fsync the rename
+    # itself can roll back and resurrect the old name.  The helper does
+    # both; a naked call almost certainly skips at least one.
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if path.endswith("utils/atomicio.py"):
+            return  # the one legitimate home of the raw call
+        aliases = _os_rename_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            d = _dotted(f)
+            named = d in ("os.replace", "os.rename", "os.renames") or (
+                isinstance(f, ast.Name) and f.id in aliases
+            )
+            if not named:
+                continue
+            fn = d.split(".")[-1] if d else f.id  # type: ignore[union-attr]
+            yield ctx.finding(
+                self.id, node,
+                f"naked os.{fn}() skips the fsync'd tmp+replace+dir-sync "
+                "dance — a crash here can install unsynced content or "
+                "resurrect the old name; use utils.atomicio."
+                "atomic_write_file (or pragma a rename of an "
+                "already-fully-synced file, with the WHY)",
+            )
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     HostSyncInJit(),
     RecompileHazard(),
     WallClockDuration(),
     SwallowedException(),
     NakedPeerRpc(),
+    NakedAtomicWrite(),
 )

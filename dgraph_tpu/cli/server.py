@@ -47,6 +47,15 @@ def build_options(argv=None) -> Options:
     p.add_argument("--bind", default=d.bind)
     p.add_argument("--sync", dest="sync_writes", action="store_true",
                    default=d.sync_writes)
+    p.add_argument("--snapshot_wal_mb", type=float,
+                   default=d.snapshot_wal_mb,
+                   help="seal+compact the WAL once it passes this many "
+                        "MB (0 = env DGRAPH_TPU_SNAPSHOT_WAL_MB or 64)")
+    p.add_argument("--snapshot_wal_records", type=int,
+                   default=d.snapshot_wal_records,
+                   help="seal+compact once this many records are "
+                        "journaled (0 = env DGRAPH_TPU_SNAPSHOT_WAL_RECORDS "
+                        "or 200000)")
     p.add_argument("--idx", dest="raft_id", type=int, default=d.raft_id)
     p.add_argument("--groups", dest="group_ids", default=d.group_ids)
     p.add_argument("--peer", default=d.peer)
@@ -107,6 +116,14 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", "cpu")
     opts = build_options(argv)
+    # snapshot thresholds: explicit flags win over the env (the
+    # Snapshotter reads the env at construction — models/durability.py)
+    if opts.snapshot_wal_mb:
+        os.environ["DGRAPH_TPU_SNAPSHOT_WAL_MB"] = str(opts.snapshot_wal_mb)
+    if opts.snapshot_wal_records:
+        os.environ["DGRAPH_TPU_SNAPSHOT_WAL_RECORDS"] = str(
+            opts.snapshot_wal_records
+        )
     # the gRPC listener port this process will bind (0 = http port + 1000)
     grpc_port = (
         -1

@@ -59,7 +59,11 @@ class SyncMarks:
             if mark <= self._persisted.get(file, 0):
                 return
             self._persisted[file] = mark
-            tmp = self.path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(self._persisted, f)
-            os.replace(tmp, self.path)
+            from dgraph_tpu.utils.atomicio import atomic_write_file
+
+            # fsync'd tmp+replace: a crash mid-persist must keep the OLD
+            # checkpoint (replaying a few lines is safe; a torn JSON file
+            # would abort the next resume entirely)
+            atomic_write_file(
+                self.path, json.dumps(self._persisted).encode()
+            )

@@ -30,7 +30,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from dgraph_tpu.models import codec
 from dgraph_tpu.models.wal import Wal, replay_records
-from dgraph_tpu.utils.env import env_float
+from dgraph_tpu.utils.atomicio import atomic_write_file
+from dgraph_tpu.utils.env import env_float, env_int
+from dgraph_tpu.utils.failpoints import fail
 
 
 def propose_patience(timeout: Optional[float] = None) -> float:
@@ -189,8 +191,25 @@ class RaftStorage:
         self.snap_index = 0
         self.snap_term = 0
         self.entries: List[Entry] = []  # entries after snap_index
+        t0 = time.monotonic()
+        self._replay_stats: dict = {}
         self._load()
         self._wal = Wal(self._log_path, sync=sync)
+        if self._replay_stats.get("records") or self._replay_stats.get(
+            "torn_bytes"
+        ):
+            # the raft twin of DurableStore's recovery line: how much log
+            # was replayed and whether a torn tail was cut (crash matrix
+            # asserts this observability survives a kill at any site)
+            import sys
+
+            print(
+                f"# recovery {directory}: snap_index={self.snap_index} "
+                f"log_records={self._replay_stats.get('records', 0)} "
+                f"torn_bytes={self._replay_stats.get('torn_bytes', 0)} "
+                f"duration={time.monotonic() - t0:.4f}s",
+                file=sys.stderr,
+            )
 
     def _load(self) -> None:
         if os.path.exists(self._hs_path):
@@ -203,7 +222,7 @@ class RaftStorage:
         if os.path.exists(self._snap_meta):
             with open(self._snap_meta, "rb") as f:
                 self.snap_index, self.snap_term = struct.unpack("<QQ", f.read(16))
-        for payload in replay_records(self._log_path):
+        for payload in replay_records(self._log_path, stats=self._replay_stats):
             term, pos = codec.uvarint(payload, 0)
             index, pos = codec.uvarint(payload, pos)
             data = bytes(payload[pos:])
@@ -219,14 +238,14 @@ class RaftStorage:
     def save_hardstate(self, term: int, voted_for: Optional[str]) -> None:
         self.term, self.voted_for = term, voted_for
         v = (voted_for or "").encode()
-        tmp = self._hs_path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(_HS.pack(term, len(v)) + v)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._hs_path)
+        # durable BEFORE any vote/term is acted on (Raft's safety
+        # prerequisite); crash sites raft.hardstate.{tmp,replace}
+        atomic_write_file(
+            self._hs_path, _HS.pack(term, len(v)) + v, site="raft.hardstate"
+        )
 
     def append(self, entries: List[Entry]) -> None:
+        fail.point("raft.log_append")
         for e in entries:
             buf = bytearray()
             codec.put_uvarint(buf, e.term)
@@ -267,19 +286,15 @@ class RaftStorage:
         return None
 
     def save_snapshot(self, index: int, term: int, data: bytes) -> None:
-        """Install/record a snapshot and drop covered entries."""
-        tmp = self._snap_path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._snap_path)
-        tmpm = self._snap_meta + ".tmp"
-        with open(tmpm, "wb") as f:
-            f.write(struct.pack("<QQ", index, term))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmpm, self._snap_meta)
+        """Install/record a snapshot and drop covered entries.  Order
+        matters for crash safety: data first, META LAST — snap_index only
+        advances once the data it points at is durably in place (a crash
+        between the two replays the old snapshot + full log, which is
+        merely slower, never wrong)."""
+        atomic_write_file(self._snap_path, data, site="raft.snapshot")
+        atomic_write_file(
+            self._snap_meta, struct.pack("<QQ", index, term)
+        )
         self.entries = [e for e in self.entries if e.index > index]
         self.snap_index, self.snap_term = index, term
         # rewrite the log with only the surviving suffix
@@ -314,7 +329,7 @@ class RaftNode:
         restore_fn: Optional[Callable[[bytes], None]] = None,
         tick_ms: int = 15,
         election_ticks: int = 10,
-        snapshot_threshold: int = 10_000,
+        snapshot_threshold: Optional[int] = None,
         passive: bool = False,
     ):
         self.node_id = node_id
@@ -327,7 +342,14 @@ class RaftNode:
         self.restore_fn = restore_fn
         self.tick_s = tick_ms / 1000.0
         self.election_ticks = election_ticks
-        self.snapshot_threshold = snapshot_threshold
+        # raft-log compaction threshold: the raft leg of the snapshot
+        # knob family (the store WAL has DGRAPH_TPU_SNAPSHOT_WAL_MB/
+        # _RECORDS; /admin/snapshot force-compacts both planes)
+        self.snapshot_threshold = (
+            snapshot_threshold
+            if snapshot_threshold is not None
+            else env_int("DGRAPH_TPU_SNAPSHOT_RAFT_RECORDS", 10_000)
+        )
 
         # passive: a joining node that does not yet know the membership —
         # it never campaigns (it would split-brain-elect itself with an
@@ -398,6 +420,12 @@ class RaftNode:
         """Ask the most caught-up follower to take over (TimeoutNow)."""
         self._inbox.put(("transfer",))
 
+    def request_snapshot(self) -> None:
+        """Force a raft-log compaction regardless of threshold
+        (/admin/snapshot's cluster leg).  Runs on the loop thread — the
+        only thread allowed to touch storage — at the next dequeue."""
+        self._inbox.put(("snapshot",))
+
     # -- public API (thread-safe) -------------------------------------------
 
     def deliver(self, msg) -> None:
@@ -456,6 +484,8 @@ class RaftNode:
                     self._handle_conf_remove(item[1])
                 elif kind == "transfer":
                     self._handle_transfer()
+                elif kind == "snapshot":
+                    self._maybe_snapshot(force=True)
             except Exception:  # noqa: BLE001 — a bad entry/storage error must
                 # not silently kill the event loop and wedge the group
                 import traceback
@@ -900,11 +930,11 @@ class RaftNode:
                     fut.set_result(self.last_applied)
         self._maybe_snapshot()
 
-    def _maybe_snapshot(self) -> None:
-        if (
-            self.snapshot_fn is None
-            or self.last_applied - self.storage.snap_index < self.snapshot_threshold
-        ):
+    def _maybe_snapshot(self, force: bool = False) -> None:
+        if self.snapshot_fn is None:
+            return
+        behind = self.last_applied - self.storage.snap_index
+        if behind <= 0 or (not force and behind < self.snapshot_threshold):
             return
         term = self.storage.term_at(self.last_applied)
         if term is None:

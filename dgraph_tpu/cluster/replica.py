@@ -159,6 +159,12 @@ class ReplicatedGroup:
     def stop(self) -> None:
         self.node.stop()
 
+    def force_snapshot(self) -> None:
+        """Compact this group's raft log now (/admin/snapshot's cluster
+        leg): group replicas ride the same trigger machinery as the
+        single-node store WAL's Snapshotter."""
+        self.node.request_snapshot()
+
     # -- raft callbacks (loop thread) ---------------------------------------
 
     def _apply_committed(self, index: int, data: bytes) -> None:

@@ -243,11 +243,19 @@ class ClusterService:
                 str(gid): {
                     "leader": g.node.leader_id,
                     "is_leader": g.node.is_leader,
+                    "snap_index": g.node.storage.snap_index,
+                    "last_applied": g.node.last_applied,
                 }
                 for gid, g in sorted(self.groups.items())
             },
             "degraded": self.store.degraded_info(),
         }
+
+    def snapshot_all(self) -> None:
+        """Force raft-log compaction on every group this server serves
+        (/admin/snapshot — the cluster twin of DurableStore.snapshot)."""
+        for g in self.groups.values():
+            g.force_snapshot()
 
     # -- runtime membership (JoinCluster, draft.go:1049 / groups.go:600) ----
 

@@ -47,7 +47,9 @@ def _build() -> Optional[str]:
             capture_output=True,
             timeout=120,
         )
-        os.replace(_SO + ".tmp", _SO)
+        # build-cache artifact, not durable state: atomicity only guards
+        # against a concurrent builder, no fsync contract needed
+        os.replace(_SO + ".tmp", _SO)  # graftlint: ignore[naked-atomic-write]
         return _SO
     except (OSError, subprocess.SubprocessError):
         return None

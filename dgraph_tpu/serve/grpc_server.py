@@ -466,12 +466,19 @@ class GrpcServer:
                                          timeout_s=timeout_s)
         except Exception as e:
             from dgraph_tpu.cluster.peerclient import StaleUnavailableError
+            from dgraph_tpu.models.durability import StorageFaultError
             from dgraph_tpu.sched import SchedDeadlineError, SchedOverloadError
 
             if isinstance(e, SchedOverloadError):
                 context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
             if isinstance(e, SchedDeadlineError):
                 context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+            if isinstance(e, StorageFaultError):
+                # disk fault / read-only mode: mutation not acknowledged,
+                # retriable after the re-arm probe (HTTP's 503 twin).
+                # Checked BEFORE StaleUnavailableError: both are OSError
+                # family but this one names the local disk, not a peer.
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             if isinstance(e, StaleUnavailableError):
                 # owner group unreachable with no cached copy: retriable
                 # service condition (the HTTP surface's 503 + Retry-After)
@@ -508,7 +515,38 @@ class GrpcServer:
         n = decode_num(req)
         if n <= 0:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "Num.val must be > 0")
-        uids = self._server.store.uids.fresh(n)
+        from dgraph_tpu.models.durability import ReadOnlyError, StorageFaultError
+
+        srv = self._server
+        try:
+            # read-only admission, same gate as the HTTP mutation path: a
+            # latched disk fault may have left a torn WAL tail, and an
+            # append landing after it would vanish from replay — the
+            # handed-out lease would be re-issued after restart
+            ro = getattr(srv.store, "storage_readonly", None)
+            if ro is not None and ro():
+                st = srv.store.health
+                raise ReadOnlyError(
+                    "storage is in read-only mode "
+                    f"({st.last_site}: {st.last_error}); "
+                    "uid leasing shed until the re-arm probe clears",
+                    retry_after=st.probe_interval_s,
+                )
+            # the lease journals to the WAL: take the engine write lock
+            # like every other journaling path, so a concurrent
+            # snapshotter seal (segment swap) or re-arm reopen can never
+            # interleave with this append
+            with srv._engine_lock.write():
+                uids = srv.store.uids.fresh(n)
+            # uid handouts must be DURABLE before the client sees them
+            # (a crash re-issuing a uid aliases entities); under group
+            # commit the fsync lives in this barrier, OUTSIDE the lock,
+            # shared with concurrent writers
+            barrier = getattr(srv.store, "sync_barrier", None)
+            if barrier is not None:
+                barrier()
+        except StorageFaultError as e:
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         return encode_assigned_ids(uids[0], uids[-1])
 
     # -- Worker plane (the reference's internal gRPC port) ----------------

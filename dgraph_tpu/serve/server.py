@@ -21,6 +21,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from dgraph_tpu.models.durability import ReadOnlyError, StorageFaultError
 from dgraph_tpu.models.store import PostingStore
 from dgraph_tpu.query.engine import QueryEngine
 from dgraph_tpu.serve.export import export as export_rdf
@@ -122,6 +123,33 @@ class DgraphServer:
             from dgraph_tpu.sched import CohortScheduler
 
             self.scheduler = CohortScheduler(self)
+        # storage plane (models/wal.py + models/durability.py), for
+        # stores that have one (DurableStore; ClusterStore's durability
+        # lives in the raft logs instead):
+        # - group commit: move the --sync fsync out of the exclusive
+        #   write section into a shared post-lock barrier so concurrent
+        #   writers amortize one fsync (DGRAPH_TPU_GROUP_COMMIT=0 keeps
+        #   the legacy fsync-per-write inside the lock)
+        # - snapshotter: the background seal/compact loop that finally
+        #   CALLS DurableStore.snapshot machinery in the serving path,
+        #   keeping the WAL bounded under sustained writes
+        import os as _os
+
+        if (
+            hasattr(store, "enable_group_commit")
+            and _os.environ.get("DGRAPH_TPU_GROUP_COMMIT", "1") != "0"
+        ):
+            store.enable_group_commit()
+        self.snapshotter = None
+        if (
+            hasattr(store, "seal_segment")
+            and _os.environ.get("DGRAPH_TPU_SNAPSHOTTER", "1") != "0"
+        ):
+            from dgraph_tpu.models.durability import Snapshotter
+
+            self.snapshotter = Snapshotter(
+                store, exclusive=self._engine_lock.write
+            )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -157,6 +185,8 @@ class DgraphServer:
             target=self._httpd.serve_forever, name="dgraph-http", daemon=True
         )
         self._thread.start()
+        if self.snapshotter is not None:
+            self.snapshotter.start()
         self.health.set_ok(True)
 
     @property
@@ -188,6 +218,10 @@ class DgraphServer:
                 # before the write lock: queued cohorts must drain (fail
                 # fast) or they would wait on a read lock that never comes
                 self.scheduler.stop()
+            if self.snapshotter is not None:
+                # likewise before the write lock: a mid-seal snapshotter
+                # holds it and must finish (or be told to stop) first
+                self.snapshotter.stop()
             with self._engine_lock.write():
                 if self.cluster is not None:
                     self.cluster.stop()
@@ -222,6 +256,19 @@ class DgraphServer:
             lat.record_parsing()
             tr.printf("parsed: %d queries, mutation=%s", len(parsed.queries),
                       parsed.mutation is not None)
+            if parsed.mutation is not None:
+                # disk-fault read-only mode: shed mutations BEFORE they
+                # queue on the write lock (reads keep flowing below);
+                # the handler maps this to 503 + Retry-After
+                ro = getattr(self.store, "storage_readonly", None)
+                if ro is not None and ro():
+                    st = self.store.health
+                    raise ReadOnlyError(
+                        "storage is in read-only mode "
+                        f"({st.last_site}: {st.last_error}); "
+                        "mutations shed until the re-arm probe clears",
+                        retry_after=st.probe_interval_s,
+                    )
             out: dict = {}
             from dgraph_tpu.query import outputnode
 
@@ -248,6 +295,15 @@ class DgraphServer:
                     stats = self._run_locked(parsed, out)
                 finally:
                     outputnode.DEBUG_UIDS.reset(debug_token)
+                if parsed.mutation is not None:
+                    # group-commit durability barrier, OUTSIDE the write
+                    # lock: the mutation is applied and journaled; the
+                    # ack (this response) waits for a shared fsync that
+                    # concurrent writers amortize (no-op unless
+                    # enable_group_commit ran — see __init__)
+                    barrier = getattr(self.store, "sync_barrier", None)
+                    if barrier is not None:
+                        barrier()
             lat.record_processing()
             tr.printf("processed")
             # json encode happens in the handler; pre-record here so the
@@ -404,6 +460,11 @@ def _make_handler(srv: DgraphServer):
                     detail = {"ok": srv.health.ok()}
                     if srv.cluster is not None:
                         detail.update(srv.cluster.health_summary())
+                    status = getattr(srv.store, "storage_status", None)
+                    if status is not None:
+                        # disk plane: read-only latch, WAL growth,
+                        # snapshot age, last recovery (models/wal.py)
+                        detail["storage"] = status()
                     code = 200 if srv.health.ok() else 503
                     self._reply(code, json.dumps(detail).encode())
                 elif srv.health.ok():
@@ -434,6 +495,31 @@ def _make_handler(srv: DgraphServer):
                     ).encode())
                 except Exception as e:  # pragma: no cover
                     self._err(500, str(e))
+            elif path == "/admin/snapshot":
+                # force a snapshot/compaction round now (the knob-driven
+                # Snapshotter's manual trigger; ?wait=1 blocks until the
+                # round completed).  Clustered servers compact every
+                # group's raft log instead (same trigger machinery).
+                qs = parse_qs(u.query)
+                wait = qs.get("wait", ["0"])[0] in ("1", "true")
+                if srv.cluster is not None:
+                    srv.cluster.snapshot_all()
+                    self._reply(200, json.dumps(
+                        {"code": "Success",
+                         "message": "Raft snapshot requested for all groups."}
+                    ).encode())
+                elif srv.snapshotter is not None:
+                    ok = srv.snapshotter.trigger(wait=wait)
+                    if ok:
+                        self._reply(200, json.dumps(
+                            {"code": "Success",
+                             "message": "Snapshot completed."
+                             if wait else "Snapshot triggered."}
+                        ).encode())
+                    else:
+                        self._err(500, "snapshot failed; see /health?detail=1")
+                else:
+                    self._err(404, "store has no snapshotter")
             elif path == "/admin/shutdown":
                 self._reply(200, json.dumps(
                     {"code": "Success", "message": "Server is shutting down"}
@@ -610,6 +696,23 @@ def _make_handler(srv: DgraphServer):
                     self._reply(504, json.dumps(
                         {"code": "ErrorDeadlineExceeded", "message": str(e)}
                     ).encode())
+                except StorageFaultError as e:
+                    # disk fault / read-only mode: the mutation was NOT
+                    # acknowledged; retriable once the re-arm probe
+                    # clears, so say exactly that (503 + Retry-After
+                    # sized to the probe interval)
+                    self._reply(
+                        503,
+                        json.dumps({
+                            "code": "ErrorServiceUnavailable",
+                            "message": str(e),
+                        }).encode(),
+                        extra_headers={
+                            "Retry-After": str(
+                                max(1, int(round(e.retry_after)))
+                            )
+                        },
+                    )
                 except StaleUnavailableError as e:
                     # owner group unreachable AND no cached snapshot to
                     # degrade to: a retriable SERVICE condition, told as

@@ -17,6 +17,13 @@ class Options:
     wal_dir: str = "w"
     export_path: str = "export"
     sync_writes: bool = False
+    # background snapshot/compaction thresholds (models/durability.py
+    # Snapshotter): seal+compact once the active WAL passes either
+    # bound.  0 = keep the env/default (DGRAPH_TPU_SNAPSHOT_WAL_MB 64 /
+    # DGRAPH_TPU_SNAPSHOT_WAL_RECORDS 200000); explicit flags win over
+    # the env, like every other flag.
+    snapshot_wal_mb: float = 0.0
+    snapshot_wal_records: int = 0
     # serving
     port: int = 8080
     # gRPC listener (cmd/dgraph/main.go:602 grpcListener; the reference

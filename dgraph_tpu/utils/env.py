@@ -16,3 +16,12 @@ def env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, default))
     except ValueError:
         return default
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer knob with the same typo-tolerant fallback (accepts float
+    text like "1e6" since operators write snapshot thresholds that way)."""
+    try:
+        return int(float(os.environ.get(name, default)))
+    except ValueError:
+        return default

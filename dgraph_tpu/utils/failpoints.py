@@ -18,9 +18,16 @@ Actions:
   models a peer that *stalls* before failing (the expensive failure mode
   — a connect timeout, not a connect refusal).
 - ``delay(ms=, p=, n=)`` — sleep without failing (slow peer / GC pause).
+- ``crash(ms=, p=, n=, after=)`` — ``os._exit`` the process, no cleanup,
+  no atexit, no flushes: the closest a test can get to SIGKILL from
+  *inside* a chosen code site.  The crash-recovery matrix
+  (tests/test_crash_matrix.py) arms this inside real server
+  subprocesses at every durability-critical site.
 
 ``p`` is the trigger probability (default 1.0), ``n`` caps how many
-times the action fires (default unlimited).  All probability draws come
+times the action fires (default unlimited), ``after`` skips the first
+N matching probes (so a crash test can let a known number of writes
+through before pulling the plug).  All probability draws come
 from ONE seeded RNG (``DGRAPH_TPU_FAILPOINT_SEED``, default 0), so a
 chaos run replays bit-identically: same seed + same call order = same
 faults.  Triggers are counted per site in
@@ -28,8 +35,13 @@ faults.  Triggers are counted per site in
 
 Instrumented sites (grep ``fail.point``): every PeerClient attempt
 (``peerclient.<op>`` — forward, snapshot, predlist, assign, join,
-raft.send), snapshot decode (``service.snapshot_decode``), and the
-cohort scheduler's flush (``sched.flush``).
+raft.send), snapshot decode (``service.snapshot_decode``), the cohort
+scheduler's flush (``sched.flush``), and the storage plane's
+durability-critical sites (``wal.append``, ``wal.flush``,
+``wal.post_flush``, ``wal.seal``, ``wal.snapshot.{tmp,replace,
+installed}``, ``raft.log_append``, ``raft.hardstate.{tmp,replace}``,
+``raft.snapshot.{tmp,replace}`` — the crash-matrix site list,
+docs/deploy.md "Durability").
 """
 
 from __future__ import annotations
@@ -47,17 +59,25 @@ class FailpointError(OSError):
     be able to tell an injected failure from a real network one."""
 
 
-_ACTION_RE = re.compile(r"^(error|delay)\s*(?:\((.*)\))?$")
+_ACTION_RE = re.compile(r"^(error|delay|crash)\s*(?:\((.*)\))?$")
 
 
 class _Action:
-    __slots__ = ("kind", "p", "n", "ms")
+    __slots__ = ("kind", "p", "n", "ms", "after")
 
-    def __init__(self, kind: str, p: float = 1.0, n: int = -1, ms: float = 0.0):
+    def __init__(
+        self,
+        kind: str,
+        p: float = 1.0,
+        n: int = -1,
+        ms: float = 0.0,
+        after: int = 0,
+    ):
         self.kind = kind
         self.p = p
         self.n = n          # remaining fires; -1 = unlimited
         self.ms = ms
+        self.after = after  # remaining probes to let through untouched
 
     @classmethod
     def parse(cls, spec: str) -> "_Action":
@@ -72,7 +92,7 @@ class _Action:
                 continue
             k, _, v = part.partition("=")
             k = k.strip()
-            if k not in ("p", "n", "ms"):
+            if k not in ("p", "n", "ms", "after"):
                 raise ValueError(f"bad failpoint param {k!r} in {spec!r}")
             kw[k] = float(v)
         return cls(
@@ -80,6 +100,7 @@ class _Action:
             p=float(kw.get("p", 1.0)),
             n=int(kw.get("n", -1)),
             ms=float(kw.get("ms", 0.0)),
+            after=int(kw.get("after", 0)),
         )
 
 
@@ -141,6 +162,9 @@ class Failpoints:
                 return
             if act.n == 0:
                 return
+            if act.after > 0:
+                act.after -= 1
+                return
             if act.p < 1.0 and self._rng.random() >= act.p:
                 return
             if act.n > 0:
@@ -152,6 +176,15 @@ class Failpoints:
         FAILPOINTS_FIRED.add(site)
         if ms > 0:
             time.sleep(ms / 1000.0)
+        if kind == "crash":
+            # the in-process SIGKILL: no atexit, no flushes, no WAL close
+            # — exactly the state a power cut leaves behind.  Flush the
+            # crash marker to stderr first so the harness can prove the
+            # exit came from THIS site, then die.
+            import sys
+
+            print(f"# failpoint crash: {site}", file=sys.stderr, flush=True)
+            os._exit(86)
         if kind == "error":
             raise FailpointError(f"failpoint {site!r} injected error")
 

@@ -380,6 +380,29 @@ FAILPOINTS_FIRED = metrics.labeled(
     "dgraph_failpoints_fired_total", label="site"
 )
 
+# storage plane (models/wal.py, models/durability.py): disk faults flip
+# the node read-only (dgraph_storage_readonly 1) until the re-arm probe
+# clears it; every fault is counted per site so an operator can tell a
+# journal-append fault from a snapshot-compaction fault.  Recovery
+# gauges describe the LAST boot replay (the observability line's
+# machine-readable twin); WAL gauges + snapshot age say whether the
+# background snapshotter is keeping the log bounded; the group-commit
+# pair's ratio (writes / syncs) is the fsync batching factor under
+# --sync.
+STORAGE_ERRORS = metrics.labeled(
+    "dgraph_storage_errors_total", label="site"
+)
+STORAGE_READONLY = metrics.gauge("dgraph_storage_readonly")
+RECOVERY_RECORDS = metrics.gauge("dgraph_recovery_records")
+RECOVERY_TORN_BYTES = metrics.gauge("dgraph_recovery_torn_bytes")
+RECOVERY_SECONDS = metrics.gauge("dgraph_recovery_seconds")
+SNAPSHOT_AGE = metrics.gauge("dgraph_snapshot_age_seconds")
+SNAPSHOTS = metrics.counter("dgraph_snapshots_total")
+WAL_BYTES = metrics.gauge("dgraph_wal_bytes")
+WAL_SEGMENTS = metrics.gauge("dgraph_wal_sealed_segments")
+GROUP_COMMIT_SYNCS = metrics.counter("dgraph_group_commit_syncs_total")
+GROUP_COMMIT_WRITES = metrics.counter("dgraph_group_commit_writes_total")
+
 
 def note_swallowed(site: str, exc: BaseException) -> None:
     """Count an intentionally-dropped exception at ``site`` (a short

@@ -17,6 +17,7 @@ from dgraph_tpu.analysis.lockorder import build_lock_graph, check_lock_order
 from dgraph_tpu.analysis.rules import (
     ALL_RULES,
     HostSyncInJit,
+    NakedAtomicWrite,
     NakedPeerRpc,
     RecompileHazard,
     SwallowedException,
@@ -290,6 +291,80 @@ def test_naked_peer_rpc_clean_counterexamples():
     ) == []
 
 
+def test_naked_atomic_write_os_replace_flagged():
+    src = textwrap.dedent("""
+        import os
+
+        def persist(path, blob):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+    """)
+    assert _ids(
+        check_source(src, [NakedAtomicWrite()], path="dgraph_tpu/models/x.py")
+    ) == ["naked-atomic-write"]
+
+
+def test_naked_atomic_write_imported_rename_flagged():
+    # `from os import replace` must not slip past the dotted-name check
+    src = textwrap.dedent("""
+        from os import replace as _rp
+
+        def persist(tmp, path):
+            _rp(tmp, path)
+    """)
+    assert _ids(
+        check_source(src, [NakedAtomicWrite()], path="dgraph_tpu/cli/x.py")
+    ) == ["naked-atomic-write"]
+
+
+def test_naked_atomic_write_clean_counterexamples():
+    # the helper itself is the one legitimate home of the raw call
+    inside = textwrap.dedent("""
+        import os
+
+        def atomic_write_file(path, data):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+    """)
+    assert check_source(
+        inside, [NakedAtomicWrite()], path="dgraph_tpu/utils/atomicio.py"
+    ) == []
+    # routing THROUGH the helper is clean anywhere
+    routed = textwrap.dedent("""
+        from dgraph_tpu.utils.atomicio import atomic_write_file
+
+        def persist(path, blob):
+            atomic_write_file(path, blob, site="raft.hardstate")
+    """)
+    assert check_source(
+        routed, [NakedAtomicWrite()], path="dgraph_tpu/cluster/raft.py"
+    ) == []
+    # a str.replace() call is not a rename
+    strings = textwrap.dedent("""
+        def norm(s):
+            return s.replace("a", "b")
+    """)
+    assert check_source(
+        strings, [NakedAtomicWrite()], path="dgraph_tpu/gql/x.py"
+    ) == []
+    # pragma'd deliberate site (rename of an already-fully-synced file)
+    sealed = textwrap.dedent("""
+        import os
+
+        def seal(path, seg):
+            os.replace(path, seg)  # graftlint: ignore[naked-atomic-write]
+    """)
+    assert check_source(
+        sealed, [NakedAtomicWrite()], path="dgraph_tpu/models/wal.py"
+    ) == []
+
+
 def test_swallowed_narrow_or_counted_not_flagged():
     src = textwrap.dedent("""
         def f():
@@ -471,6 +546,9 @@ _CLI_BAD = {
     "naked-peer-rpc": (
         "from dgraph_tpu.cluster.transport import urlopen_peer\n\n"
         "def f(req, auth):\n    return urlopen_peer(req, 5, auth)\n"
+    ),
+    "naked-atomic-write": (
+        "import os\n\ndef f(tmp, path):\n    os.replace(tmp, path)\n"
     ),
 }
 

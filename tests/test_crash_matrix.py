@@ -1,0 +1,488 @@
+"""The kill-at-every-site crash-recovery matrix (ISSUE 6 tentpole #4).
+
+Real server subprocesses (``python -m dgraph_tpu.cli.server --sync``),
+a ``crash`` failpoint (``os._exit(86)`` — the closest an in-process test
+gets to SIGKILL) armed at one durability-critical site per case, plus a
+literal ``SIGKILL`` case.  For every site the harness:
+
+1. boots the server(s) on fresh directories and drives acknowledged
+   writes until the armed process dies (exit 86, stderr carries
+   ``# failpoint crash: <site>`` proving the kill came from THAT site);
+2. restarts on the SAME directories with failpoints disarmed;
+3. asserts every acknowledged write is present, the write in flight at
+   the crash honored its site's contract (absent before the journal
+   write, present after the fsync, never torn), a rejected write never
+   resurfaces, and the recovery observability line was emitted.
+
+Cluster cases additionally assert the killed node rejoins its group and
+catches up to read parity, and that the group commits new writes after.
+
+Marked ``crash`` + ``slow``: a dedicated CI job runs ``-m crash`` with a
+pinned ``DGRAPH_TPU_FAILPOINT_SEED``; tier-1 never pays the subprocess
+boots.  docs/deploy.md "Durability" documents the site list.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+pytestmark = [pytest.mark.crash, pytest.mark.slow]
+
+BOOT_TIMEOUT = 90.0
+CRASH_EXIT = 86
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class Node:
+    """One real server subprocess with captured stdio."""
+
+    def __init__(self, tmp_path, name: str, args, env_extra=None):
+        self.dir = str(tmp_path / f"{name}-p")
+        self.port = None
+        self.name = name
+        self._tmp = tmp_path
+        self._seq = 0
+        self.proc = None
+        self.log = None
+        self.args = args
+        self.env_extra = dict(env_extra or {})
+
+    def start(self, port=None, failpoints: str = "", extra_env=None):
+        self.port = port or self.port or _free_port()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        # the package is run from a source tree, not an install: the
+        # subprocess must find dgraph_tpu regardless of pytest's cwd
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        env["DGRAPH_TPU_FAILPOINT_SEED"] = env.get(
+            "DGRAPH_TPU_FAILPOINT_SEED", "0"
+        )
+        env.pop("DGRAPH_TPU_FAILPOINTS", None)
+        if failpoints:
+            env["DGRAPH_TPU_FAILPOINTS"] = failpoints
+        env.update(self.env_extra)
+        env.update(extra_env or {})
+        self._seq += 1
+        self.log = str(self._tmp / f"{self.name}-{self._seq}.log")
+        logf = open(self.log, "wb")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-u", "-m", "dgraph_tpu.cli.server",
+             "--p", self.dir, "--port", str(self.port), "--grpc_port", "-1",
+             *self.args],
+            stdout=logf, stderr=subprocess.STDOUT, env=env,
+        )
+        return self
+
+    def wait_healthy(self, timeout=BOOT_TIMEOUT):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise AssertionError(
+                    f"{self.name} exited rc={self.proc.returncode} during "
+                    f"boot:\n{self.read_log()[-3000:]}"
+                )
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.port}/health", timeout=2
+                ) as r:
+                    if r.status == 200:
+                        return self
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.1)
+        raise AssertionError(f"{self.name} never became healthy")
+
+    def wait_exit(self, timeout=60.0) -> int:
+        try:
+            return self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            raise AssertionError(
+                f"{self.name} still alive; expected the armed crash site "
+                f"to fire.\n{self.read_log()[-3000:]}"
+            )
+
+    def read_log(self) -> str:
+        try:
+            with open(self.log, "rb") as f:
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+    def kill(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+
+    def terminate(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=30)
+
+
+def _post(port: int, body: str, timeout=30.0) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/query", data=body.encode()
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _mut(i: int) -> str:
+    return 'mutation { set { <0x%x> <cv> "%d" . } }' % (i, i)
+
+
+def _read_cv(port: int, i: int, timeout=30.0):
+    out = _post(port, "{ q(func: uid(0x%x)) { cv } }" % i, timeout=timeout)
+    vals = [n.get("cv") for n in out.get("q", [])]
+    return vals[0] if vals else None
+
+
+def _post_retry(port: int, body: str, deadline_s=120.0) -> dict:
+    """Bounded retry over the transient classes a settling/rejoining
+    cluster produces (the test_cluster_http discipline)."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return _post(port, body, timeout=60)
+        except urllib.error.HTTPError as e:
+            transient = e.code in (409, 503) or e.code >= 500
+            if e.code == 400:
+                try:
+                    msg = json.loads(e.read().decode()).get("message", "")
+                except Exception:
+                    msg = ""
+                low = msg.lower()
+                transient = not msg or any(
+                    t in low for t in ("leader", "retry", "timed out")
+                )
+            if not transient or time.monotonic() >= deadline:
+                raise
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+        time.sleep(0.5)
+
+
+def _drive_until_crash(node: Node, start=1, max_writes=200,
+                       per_write_timeout=30.0, force_snapshot=False):
+    """Sequential acked writes until the process dies.  Returns
+    (acked list, in-flight index or None)."""
+    acked, inflight = [], None
+    for i in range(start, start + max_writes):
+        if node.proc.poll() is not None:
+            break
+        try:
+            _post(node.port, _mut(i), timeout=per_write_timeout)
+            acked.append(i)
+        except (urllib.error.HTTPError, OSError):
+            inflight = i
+            break
+        if force_snapshot and i % 10 == 0:
+            # belt-and-braces for snapshot-window sites: the background
+            # loop fires on its own 1s cadence, this bounds the wait
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{node.port}/admin/snapshot",
+                    timeout=per_write_timeout,
+                )
+            except (urllib.error.URLError, OSError):
+                pass
+    return acked, inflight
+
+
+# ------------------------------------------------------------ single node
+
+# site → (failpoint spec, extra env, contract for the in-flight write)
+#   absent : the crash fired BEFORE the frame reached the journal
+#   present: the crash fired AFTER the fsync — durable though unacked
+#   any    : either, but never torn (recovery must stay clean)
+SINGLE_SITES = {
+    "wal.append": ("crash(after=6)", {}, "absent"),
+    "wal.flush": ("crash(after=6)", {}, "any"),
+    "wal.post_flush": ("crash(after=6)", {}, "present"),
+    "wal.seal": ("crash", {"DGRAPH_TPU_SNAPSHOT_WAL_RECORDS": "8"}, "any"),
+    "wal.snapshot.tmp": (
+        "crash", {"DGRAPH_TPU_SNAPSHOT_WAL_RECORDS": "8"}, "any"),
+    "wal.snapshot.replace": (
+        "crash", {"DGRAPH_TPU_SNAPSHOT_WAL_RECORDS": "8"}, "any"),
+    "wal.snapshot.installed": (
+        "crash", {"DGRAPH_TPU_SNAPSHOT_WAL_RECORDS": "8"}, "any"),
+}
+
+
+@pytest.mark.parametrize("site", sorted(SINGLE_SITES))
+def test_single_node_crash_site(tmp_path, site):
+    spec, env_extra, contract = SINGLE_SITES[site]
+    node = Node(tmp_path, "solo", ["--sync"], env_extra=env_extra)
+    node.start(failpoints=f"{site}={spec}").wait_healthy()
+    _post(node.port, "mutation { schema { cv: string . } }")
+    # a REJECTED write: answered with an error, must never resurface
+    with pytest.raises(urllib.error.HTTPError):
+        _post(node.port, 'mutation { set { <0x77777> <cv> } }')
+    snapshotting = site.startswith(("wal.seal", "wal.snapshot"))
+    acked, inflight = _drive_until_crash(
+        node, force_snapshot=snapshotting
+    )
+    rc = node.wait_exit()
+    assert rc == CRASH_EXIT, node.read_log()[-3000:]
+    assert f"# failpoint crash: {site}" in node.read_log()
+    assert acked, "no write was ever acknowledged before the crash"
+
+    # restart on the same directory, failpoints disarmed
+    node.start().wait_healthy()
+    try:
+        log_after_boot = node.read_log()
+        assert "# recovery" in log_after_boot, (
+            "recovery observability line missing:\n" + log_after_boot[-2000:]
+        )
+        for i in acked:
+            assert _read_cv(node.port, i) == str(i), (
+                f"acknowledged write {i} lost after crash at {site}"
+            )
+        # rejected write never resurfaces
+        assert _read_cv(node.port, 0x77777) is None
+        # in-flight write honors the site's contract
+        if inflight is not None:
+            got = _read_cv(node.port, inflight)
+            if contract == "absent":
+                assert got is None, (
+                    f"unacked write {inflight} resurfaced after {site}"
+                )
+            elif contract == "present":
+                assert got == str(inflight), (
+                    f"fsynced write {inflight} lost after {site}"
+                )
+            else:
+                assert got in (None, str(inflight))
+        # the write path still works post-recovery
+        nxt = (acked[-1] if acked else 0) + 1000
+        _post(node.port, _mut(nxt))
+        assert _read_cv(node.port, nxt) == str(nxt)
+    finally:
+        node.terminate()
+
+
+def test_single_node_restart_replays_only_post_snapshot_tail(tmp_path):
+    """Bounded-WAL acceptance, subprocess edition: after a sustained run
+    with a low snapshot threshold, the restart's recovery line shows the
+    bulk of the records coming from the snapshot, not WAL replay."""
+    node = Node(
+        tmp_path, "bounded", ["--sync"],
+        env_extra={"DGRAPH_TPU_SNAPSHOT_WAL_RECORDS": "20"},
+    )
+    node.start().wait_healthy()
+    total = 90
+    try:
+        _post(node.port, "mutation { schema { cv: string . } }")
+        for i in range(1, total + 1):
+            _post(node.port, _mut(i))
+        # final explicit round so the tail is compacted deterministically
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{node.port}/admin/snapshot?wait=1", timeout=60
+        ) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{node.port}/health?detail=1", timeout=30
+        ) as r:
+            st = json.loads(r.read())["storage"]
+        assert st["sealed_segments"] == 0 and st["wal_records"] == 0
+    finally:
+        node.terminate()
+    node.start().wait_healthy()
+    try:
+        for m in ("# recovery",):
+            assert m in node.read_log()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{node.port}/health?detail=1", timeout=30
+        ) as r:
+            rec = json.loads(r.read())["storage"]["last_recovery"]
+        assert rec["snapshot_records"] > 0
+        assert rec["wal_records"] + rec["segment_records"] < total
+        for i in (1, total // 2, total):
+            assert _read_cv(node.port, i) == str(i)
+    finally:
+        node.terminate()
+
+
+# --------------------------------------------------------------- cluster
+
+def _cluster_nodes(tmp_path, env2=None):
+    p1, p2 = _free_port(), _free_port()
+    peers = f"1@127.0.0.1:{p1},2@127.0.0.1:{p2}"
+    # group 0 = metadata, group 1 = the data group every predicate maps
+    # to (the CLI default of "0" alone serves no data group at all)
+    common = ["--sync", "--peer", peers, "--groups", "0,1"]
+    env = {"DGRAPH_TPU_PROPOSE_TIMEOUT": "45"}
+    n1 = Node(tmp_path, "n1", ["--idx", "1", *common], env_extra=env)
+    n2 = Node(
+        tmp_path, "n2", ["--idx", "2", *common],
+        env_extra={**env, **(env2 or {})},
+    )
+    n1.port, n2.port = p1, p2
+    return n1, n2
+
+
+def _wait_parity(node: Node, acked, deadline_s=120.0):
+    deadline = time.monotonic() + deadline_s
+    missing = list(acked)
+    while missing and time.monotonic() < deadline:
+        still = []
+        for i in missing:
+            try:
+                if _read_cv(node.port, i, timeout=15) != str(i):
+                    still.append(i)
+            except (urllib.error.URLError, OSError):
+                still.append(i)
+        missing = still
+        if missing:
+            time.sleep(0.5)
+    assert not missing, (
+        f"{node.name} never caught up; missing {missing[:10]}..."
+    )
+
+
+CLUSTER_SITES = {
+    # follower/leader log append: crash BEFORE entries hit the raft WAL
+    "raft.log_append": ("crash(after=8)", {}),
+    # hardstate save (term/vote): fires during the election a fresh boot
+    # runs; the kill lands before any new-term vote is acted on
+    "raft.hardstate.tmp": ("crash", {}),
+    "raft.hardstate.replace": ("crash", {}),
+    # raft-log compaction: data file's two atomic-write windows
+    "raft.snapshot.tmp": (
+        "crash", {"DGRAPH_TPU_SNAPSHOT_RAFT_RECORDS": "6"}),
+    "raft.snapshot.replace": (
+        "crash", {"DGRAPH_TPU_SNAPSHOT_RAFT_RECORDS": "6"}),
+}
+
+
+@pytest.mark.parametrize("site", sorted(CLUSTER_SITES))
+def test_cluster_crash_site_rejoin_and_catchup(tmp_path, site):
+    spec, env2 = CLUSTER_SITES[site]
+    n1, n2 = _cluster_nodes(tmp_path, env2=env2)
+    hardstate = site.startswith("raft.hardstate")
+    acked = []
+    try:
+        if hardstate:
+            # phase 1: clean cluster, durable baseline, clean shutdown —
+            # the armed boot then crashes inside the ELECTION's hardstate
+            # save, with real data on disk to preserve
+            n1.start().wait_healthy()
+            n2.start().wait_healthy()
+            _post_retry(n1.port, "mutation { schema { cv: string . } }")
+            for i in range(1, 7):
+                _post_retry(n1.port, _mut(i))
+                acked.append(i)
+            n2.terminate()
+            n1.terminate()
+            # phase 2: both reboot (fresh election), node 2 armed
+            n1.start()
+            n2.start(failpoints=f"{site}={spec}")
+            n1.wait_healthy()
+            rc = n2.wait_exit(timeout=90)
+        else:
+            n1.start().wait_healthy()
+            n2.start(failpoints=f"{site}={spec}").wait_healthy()
+            _post_retry(n1.port, "mutation { schema { cv: string . } }")
+            # drive writes until the armed node dies; a failed write with
+            # node 2 still up is leader/placement settling — retry the
+            # SAME index (idempotent set) instead of ending the drive
+            # before the armed site ever fired
+            deadline = time.monotonic() + 150
+            i = 1
+            while i < 60 and time.monotonic() < deadline:
+                if n2.proc.poll() is not None:
+                    break
+                try:
+                    _post(n1.port, _mut(i), timeout=20)
+                    acked.append(i)
+                    i += 1
+                except (urllib.error.HTTPError, OSError):
+                    if n2.proc.poll() is not None:
+                        break
+                    time.sleep(0.5)
+            rc = n2.wait_exit(timeout=90)
+        assert rc == CRASH_EXIT, n2.read_log()[-3000:]
+        assert f"# failpoint crash: {site}" in n2.read_log()
+
+        # restart the killed node on the SAME directory, disarmed
+        n2.start().wait_healthy()
+        # rejoin + catch-up: read parity for every acked write on BOTH
+        _wait_parity(n1, acked)
+        _wait_parity(n2, acked)
+        # quorum restored: the group commits new writes again
+        nxt = (acked[-1] if acked else 0) + 500
+        _post_retry(n1.port, _mut(nxt))
+        _wait_parity(n2, [nxt])
+    finally:
+        n2.kill()
+        n1.kill()
+
+
+def test_cluster_sigkill_mid_traffic_rejoin(tmp_path):
+    """The satellite: SIGKILL (no failpoint at all) one node of a 2-node
+    group mid-traffic, restart it on the same --p directory, assert
+    rejoin + raft catch-up + read parity on both nodes."""
+    n1, n2 = _cluster_nodes(tmp_path)
+    try:
+        n1.start().wait_healthy()
+        n2.start().wait_healthy()
+        _post_retry(n1.port, "mutation { schema { cv: string . } }")
+        acked = []
+        for i in range(1, 11):
+            _post_retry(n1.port, _mut(i))
+            acked.append(i)
+        # kill -9 in the middle of ongoing traffic
+        killer_fired = []
+
+        def kill_late():
+            time.sleep(0.2)
+            os.kill(n2.proc.pid, signal.SIGKILL)
+            killer_fired.append(True)
+
+        import threading
+
+        t = threading.Thread(target=kill_late)
+        t.start()
+        for i in range(11, 40):
+            try:
+                _post(n1.port, _mut(i), timeout=15)
+                acked.append(i)
+            except (urllib.error.HTTPError, OSError):
+                break  # quorum lost: node 2 is dead
+        t.join()
+        assert killer_fired
+        n2.proc.wait(timeout=30)
+
+        n2.start().wait_healthy()
+        _wait_parity(n1, acked)
+        _wait_parity(n2, acked)
+        _post_retry(n1.port, _mut(4242))
+        _wait_parity(n2, [4242])
+        _wait_parity(n1, [4242])
+    finally:
+        n2.kill()
+        n1.kill()

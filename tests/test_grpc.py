@@ -635,3 +635,48 @@ def test_parse_error_maps_to_invalid_argument(chan):
         with pytest.raises(grpc.RpcError) as ei:
             _run(chan, _str_field(1, bad))
         assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT, bad
+
+
+def test_storage_readonly_maps_to_unavailable(tmp_path, monkeypatch):
+    """Disk-fault read-only mode on the gRPC surface (ISSUE 6): a
+    mutation gets UNAVAILABLE (the HTTP 503 twin) while reads keep
+    answering on the same channel."""
+    from dgraph_tpu.models.wal import DurableStore
+    from dgraph_tpu.utils.failpoints import fail
+
+    monkeypatch.setenv("DGRAPH_TPU_STORAGE_PROBE_S", "30")
+    store = DurableStore(str(tmp_path / "p"))
+    srv = DgraphServer(store, port=0)
+    srv.start()
+    gsrv = GrpcServer(srv, port=0)
+    gsrv.start()
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{gsrv.port}") as ch:
+            _run(ch, _str_field(1, 'mutation { schema { name: string . } '
+                                   'set { <0x1> <name> "A" . } }'))
+            fail.arm("wal.append", "error(n=100)")
+            with pytest.raises(grpc.RpcError) as ei:
+                _run(ch, _str_field(
+                    1, 'mutation { set { <0x2> <name> "B" . } }'
+                ))
+            assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+            # reads still serve from memory on the same channel
+            out = _run(ch, _str_field(1, "{ q(func: uid(0x1)) { name } }"))
+            assert out["q"] == [{"name": "A"}]
+            # uid leasing journals too: it must be shed at admission
+            # (not after handing out a lease that a torn tail could
+            # swallow), with the same UNAVAILABLE mapping
+            with pytest.raises(grpc.RpcError) as ei2:
+                ch.unary_unary("/protos.Dgraph/AssignUids")(encode_num(4))
+            assert ei2.value.code() == grpc.StatusCode.UNAVAILABLE
+            # fault clears -> probe re-arms -> leases flow again
+            fail.disarm("wal.append")
+            assert store.health.probe_now()
+            got = decode_assigned_ids(
+                ch.unary_unary("/protos.Dgraph/AssignUids")(encode_num(4))
+            )
+            assert got[1] - got[0] == 3
+    finally:
+        fail.reset()
+        gsrv.stop()
+        srv.stop()
