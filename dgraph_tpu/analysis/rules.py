@@ -34,6 +34,13 @@ on a noisy 2-core CPU host:
   crash can observe a half-state or resurrect the old name.  The rare
   deliberate site (a rename of an already-fully-synced file, a build
   artifact) carries the pragma with a WHY comment.
+- ``naked-stage-timing``: direct ``time.perf_counter*`` stage
+  bracketing in ``serve/``, ``sched/``, ``query/`` or ``cache/`` —
+  stage timing in the serving tree must go through the span API
+  (``dgraph_tpu.obs``: hop spans, ``obs.stage``) so the number is
+  attributable to a trace instead of vanishing into a local variable;
+  ``obs/`` and ``utils/trace.py`` are the sanctioned homes of the raw
+  clock reads.
 
 Suppress a deliberate site with ``# graftlint: ignore[rule-id]`` on the
 line (or the line above).  docs/analysis.md has the full catalog and
@@ -598,6 +605,94 @@ class NakedAtomicWrite(Rule):
             )
 
 
+# -- rule: naked-stage-timing -----------------------------------------------
+
+def _is_perf_counter_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and _dotted(node.func).split(".")[-1]
+        in ("perf_counter", "perf_counter_ns")
+        and not node.args
+    )
+
+
+class NakedStageTiming(Rule):
+    id = "naked-stage-timing"
+    doc = (
+        "direct time.perf_counter* stage bracketing in serve/, sched/, "
+        "query/ or cache/ — route stage timing through the span API "
+        "(dgraph_tpu.obs: hop spans / obs.stage) so the number lands in "
+        "traces, not a local variable"
+    )
+
+    # only the serving tree: these are the layers whose stage timings
+    # the flight recorder exists to attribute.  obs/ and utils/trace.py
+    # ARE the span API — the raw clock reads live there by design.
+    _DIRS = ("serve/", "sched/", "query/", "cache/")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if "obs/" in path or path.endswith("utils/trace.py"):
+            return
+        if not any(d in path for d in self._DIRS):
+            return
+        # same scope discipline as wallclock-duration: names assigned
+        # from perf_counter in a scope taint only that scope
+        scopes: List[ast.AST] = [ctx.tree]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node)
+        seen: Set[int] = set()
+        for scope in scopes:
+            timers = self._timer_names(scope)
+            for node in WallClockDuration._walk_scope(scope):
+                if id(node) in seen:
+                    continue
+                if not (
+                    isinstance(node, ast.BinOp)
+                    and isinstance(node.op, (ast.Add, ast.Sub))
+                ):
+                    continue
+                sides = (node.left, node.right)
+                if any(_is_perf_counter_call(s) for s in sides) or any(
+                    isinstance(s, ast.Name) and s.id in timers
+                    for s in sides
+                ):
+                    seen.add(id(node))
+                    yield ctx.finding(
+                        self.id, node,
+                        "perf_counter stage bracketing outside the span "
+                        "API: this duration can never be attributed to a "
+                        "trace — wrap the stage in obs.stage(stats, key) "
+                        "or record it as a span attr (dgraph_tpu/obs/), "
+                        "or pragma the site with the WHY",
+                    )
+
+    @staticmethod
+    def _timer_names(scope: ast.AST) -> Set[str]:
+        names: Set[str] = set()
+        for node in WallClockDuration._walk_scope(scope):
+            if isinstance(node, ast.Assign) and _is_perf_counter_call(
+                node.value
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Tuple
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Tuple) and len(t.elts) == len(
+                        node.value.elts
+                    ):
+                        for tgt, val in zip(t.elts, node.value.elts):
+                            if isinstance(
+                                tgt, ast.Name
+                            ) and _is_perf_counter_call(val):
+                                names.add(tgt.id)
+        return names
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     HostSyncInJit(),
     RecompileHazard(),
@@ -605,4 +700,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     SwallowedException(),
     NakedPeerRpc(),
     NakedAtomicWrite(),
+    NakedStageTiming(),
 )

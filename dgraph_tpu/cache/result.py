@@ -30,6 +30,7 @@ from __future__ import annotations
 import hashlib
 from typing import Optional, Tuple
 
+from dgraph_tpu import obs
 from dgraph_tpu.cache.core import VersionedLFUCache, env_bytes
 from dgraph_tpu.utils.metrics import (
     QCACHE_HIT_AGE,
@@ -121,7 +122,19 @@ class ResultCache:
     def get(self, key, version: int) -> Optional[Tuple[dict, dict]]:
         """(response, stats) for the request ``key`` at ``version``, or
         None.  The returned response is SHARED — read-only downstream."""
-        hit = self._c.get(request_digest(key), version)
+        sp = obs.current_span()
+        if sp is None:  # unsampled hot path: probe only
+            hit, _ev, _nb = self._c.get_ev(request_digest(key), version)
+        else:
+            # sampled: a tier-2 hit is the single most latency-deciding
+            # event a request can have — the span says so explicitly
+            # (outcome + the STORED size: re-walking the response here
+            # would add O(response) work to the fastest path we have)
+            with sp.child("cache.result") as cs:
+                hit, ev, nb = self._c.get_ev(request_digest(key), version)
+                cs.set_attr("outcome", ev)
+                if hit is not None:
+                    cs.set_attr("bytes", nb)
         if hit is None:
             return None
         value, age = hit

@@ -245,6 +245,14 @@ def main(argv=None) -> int:
         store = cluster.store
     else:
         store = DurableStore(opts.postings_dir, sync_writes=opts.sync_writes)
+    if opts.trace_ratio > 0 and not os.environ.get("DGRAPH_TPU_TRACE_RATIO"):
+        # --trace drives BOTH samplers: the legacy /debug/requests ring
+        # (below, via DgraphServer) and the flight recorder's head
+        # sampler (obs/spans.py) — one operator knob, the env var wins
+        # when set explicitly
+        from dgraph_tpu import obs
+
+        obs.configure(ratio=opts.trace_ratio)
     srv = DgraphServer(
         store,
         port=opts.port,

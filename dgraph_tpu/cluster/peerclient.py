@@ -60,6 +60,7 @@ import time
 import urllib.error
 from typing import Callable, Dict, List, Optional, Tuple
 
+from dgraph_tpu import obs
 from dgraph_tpu.cluster.transport import PeerAuth, urlopen_peer
 from dgraph_tpu.utils.env import env_float as _env_f
 from dgraph_tpu.utils.failpoints import fail
@@ -365,9 +366,20 @@ class PeerClient:
             return attempt(off_timeout if off_timeout is not None else budget)
         n_attempts = max(1, int(attempts if attempts is not None else self.attempts))
         deadline = None if budget is None else time.monotonic() + budget
+        # flight recorder: the calling thread's span (the query's engine
+        # span, a forwarder's root, …) — every attempt below records one
+        # child with the breaker/backoff outcome, so a trace shows each
+        # wire try, not just the final verdict.  None = unsampled: no
+        # span objects anywhere on this path.
+        tsp = obs.current_span()
         admitted, retry_after, probe_token = self._admit(peer, op)
         if not admitted:
             PEER_RPC.add((peer, op, "open"))
+            if tsp is not None:
+                with tsp.child(f"rpc.{op}") as a:
+                    a.set_attr("peer", peer)
+                    a.set_attr("outcome", "breaker_open")
+                    a.set_attr("retry_after_s", round(retry_after, 3))
             raise BreakerOpenError(peer, op, retry_after)
         last: Optional[BaseException] = None
         made = 0  # attempts actually issued (≠ n_attempts under sheds)
@@ -389,47 +401,86 @@ class PeerClient:
                     )
                     per = max(per, _MIN_ATTEMPT_TIMEOUT)
                 made = i + 1
+                asp = None
+                if tsp is not None:
+                    asp = tsp.child(f"rpc.{op}")
+                    asp.set_attr("peer", peer)
+                    asp.set_attr("attempt", i + 1)
+                    if per is not None:
+                        asp.set_attr("timeout_s", round(per, 3))
                 try:
-                    fail.point(f"peerclient.{op}")
-                    res = attempt(per)
-                except urllib.error.HTTPError:
-                    # an HTTP response IS the peer talking: transport is fine
-                    self._record(peer, op, True)
-                    PEER_RPC.add((peer, op, "http_error"))
-                    PEER_RPC_ATTEMPTS.observe(i + 1)
-                    raise
-                except transient as e:
-                    if alive is not None and alive(e):
-                        # the peer RESPONDED with an application-level
-                        # rejection: transport is fine, same rule as the
-                        # HTTPError arm above
+                    try:
+                        fail.point(f"peerclient.{op}")
+                        res = attempt(per)
+                        if asp is not None:
+                            # BEFORE the finally publishes the span: a
+                            # reader racing the finish must never see a
+                            # successful attempt with no outcome
+                            asp.set_attr("outcome", "ok")
+                    except urllib.error.HTTPError as e:
+                        # an HTTP response IS the peer talking: transport is fine
                         self._record(peer, op, True)
                         PEER_RPC.add((peer, op, "http_error"))
                         PEER_RPC_ATTEMPTS.observe(i + 1)
+                        if asp is not None:
+                            asp.set_attr("outcome", "http_error")
+                            asp.set_attr("code", getattr(e, "code", 0))
                         raise
-                    last = e
-                    self._record(peer, op, False)
-                    if self.state_of(peer, op) == OPEN:
-                        break  # this attempt tripped the breaker: stop burning budget
-                    if i + 1 < n_attempts:
-                        b = min(
-                            self.backoff_cap, self.backoff_base * (2 ** i)
-                        ) * self._rng.random()
-                        if deadline is not None:
-                            b = min(b, max(0.0, deadline - time.monotonic()))
-                        PEER_BACKOFF.observe(b)
-                        if b > 0:
-                            time.sleep(b)
-                    continue
-                except Exception:
-                    # not transient, not an HTTP response: the peer spoke
-                    # garbage (BadStatusLine, truncated frame, …).  Count
-                    # it as a transport failure — un-recorded, a half-open
-                    # probe's flag would leak and wedge the breaker shut.
-                    self._record(peer, op, False)
-                    PEER_RPC.add((peer, op, "unavailable"))
-                    PEER_RPC_ATTEMPTS.observe(i + 1)
-                    raise
+                    except transient as e:
+                        if alive is not None and alive(e):
+                            # the peer RESPONDED with an application-level
+                            # rejection: transport is fine, same rule as the
+                            # HTTPError arm above
+                            self._record(peer, op, True)
+                            PEER_RPC.add((peer, op, "http_error"))
+                            PEER_RPC_ATTEMPTS.observe(i + 1)
+                            if asp is not None:
+                                asp.set_attr("outcome", "http_error")
+                            raise
+                        last = e
+                        self._record(peer, op, False)
+                        if asp is not None:
+                            asp.set_attr("outcome", "transient")
+                            asp.set_attr("error", type(e).__name__)
+                            asp.set_attr(
+                                "breaker", self.state_of(peer, op)
+                            )
+                        if self.state_of(peer, op) == OPEN:
+                            break  # this attempt tripped the breaker: stop burning budget
+                        if i + 1 < n_attempts:
+                            b = min(
+                                self.backoff_cap, self.backoff_base * (2 ** i)
+                            ) * self._rng.random()
+                            if deadline is not None:
+                                b = min(b, max(0.0, deadline - time.monotonic()))
+                            PEER_BACKOFF.observe(b)
+                            if asp is not None:
+                                # close the attempt span BEFORE sleeping:
+                                # a 5ms refused connect must not render
+                                # as a 500ms "slow peer" — the deliberate
+                                # backoff rides as an attr, not as span
+                                # duration (finish is idempotent; the
+                                # finally below no-ops)
+                                asp.set_attr("backoff_s", round(b, 4))
+                                asp.finish()
+                            if b > 0:
+                                time.sleep(b)
+                        continue
+                    except Exception as e:
+                        # not transient, not an HTTP response: the peer spoke
+                        # garbage (BadStatusLine, truncated frame, …).  Count
+                        # it as a transport failure — un-recorded, a half-open
+                        # probe's flag would leak and wedge the breaker shut.
+                        self._record(peer, op, False)
+                        PEER_RPC.add((peer, op, "unavailable"))
+                        PEER_RPC_ATTEMPTS.observe(i + 1)
+                        if asp is not None:
+                            asp.set_attr("outcome", "garbage")
+                            asp.set_attr("error", type(e).__name__)
+                        raise
+                finally:
+                    if asp is not None:
+                        asp.finish()
                 self._record(peer, op, True)
                 PEER_RPC.add((peer, op, "ok"))
                 PEER_RPC_ATTEMPTS.observe(i + 1)
@@ -457,6 +508,12 @@ class PeerClient:
     ):
         """The HTTP peer call: ``urlopen_peer`` wrapped in retry/breaker.
         Returns the (context-managed) response object."""
+        # trace propagation: a sampled caller's context rides the W3C
+        # traceparent header, so the remote node records ITS half of the
+        # trace under the same trace_id (obs/spans.py)
+        sp = obs.current_span()
+        if sp is not None and hasattr(req, "add_header"):
+            req.add_header("Traceparent", obs.format_traceparent(sp))
 
         def attempt(t: Optional[float]):
             return urlopen_peer(req, t if t is not None else 10.0, self.auth)
@@ -495,6 +552,14 @@ class PeerClient:
         rpc = mcs.get(method)
         if rpc is None:
             rpc = mcs[method] = channel.unary_unary(method)
+
+        # trace propagation, gRPC leg: traceparent rides metadata (same
+        # W3C field the HTTP leg puts in a header)
+        sp = obs.current_span()
+        if sp is not None:
+            metadata = list(metadata or []) + [
+                ("traceparent", obs.format_traceparent(sp))
+            ]
 
         def attempt(t: Optional[float]):
             return rpc(payload, timeout=t, metadata=metadata)

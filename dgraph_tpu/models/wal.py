@@ -52,6 +52,7 @@ import time
 import zlib
 from typing import Callable, Dict, Iterator, List, Optional
 
+from dgraph_tpu import obs
 from dgraph_tpu.models import codec
 from dgraph_tpu.models.durability import (
     SnapshotCorruptError,
@@ -129,21 +130,42 @@ class Wal:
         """Group-commit barrier: make every record appended+flushed
         through ``seq`` (default: all so far) durable, sharing fsyncs —
         barriers that queue behind a leader's fsync covering their seq
-        return without touching the disk."""
+        return without touching the disk.
+
+        Sampled mutations record the barrier as a span
+        (``wal.group_commit``): its duration is the ack's durability
+        cost, and the ``fsync`` attr says whether THIS writer led the
+        fsync or rode a leader's — the per-trace view of the
+        writes/syncs amortization ratio."""
         if not self.sync:
             return
         if seq is None:
             seq = self._seq
+        sp = obs.current_span()
+        if sp is None:
+            # discard _sync_upto's bool: sync_upto returns None on EVERY
+            # path, sampled or not — a caller must never see a return
+            # shape that depends on whether tracing happened to be on
+            self._sync_upto(seq)
+            return
+        with sp.child("wal.group_commit") as bs:
+            bs.set_attr("seq", seq)
+            led = self._sync_upto(seq)
+            bs.set_attr("fsync", led)
+
+    def _sync_upto(self, seq: int) -> bool:
+        """The barrier proper; True when this caller LED an fsync."""
         GROUP_COMMIT_WRITES.add(1)
         with self._sync_lock:
             if self._synced_seq >= seq:
-                return  # a leader's fsync already covered us
+                return False  # a leader's fsync already covered us
             target = self._flushed_seq
             os.fsync(self._f.fileno())
             fail.point("wal.post_flush")
             GROUP_COMMIT_SYNCS.add(1)
             if target > self._synced_seq:
                 self._synced_seq = target
+            return True
 
     def close(self) -> None:
         self.flush()

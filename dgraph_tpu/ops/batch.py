@@ -223,16 +223,31 @@ def multi_hop(
     """
     import warnings
 
-    with warnings.catch_warnings():
+    from dgraph_tpu import obs
+
+    # sampled requests record the whole fused scan as ONE span (it IS
+    # one device program): hop count + capacity say what the chain/
+    # recurse planner committed to, device_sync_ms splits compute from
+    # the caller's later fetch.  Unsampled: no span, dispatch stays
+    # fully async.
+    sp = obs.current_span()
+    ms = obs.NOOP if sp is None else sp.child("multi_hop")
+    with warnings.catch_warnings(), ms:
         # backends that cannot alias a given carry (e.g. the untouched
         # visited buffer when track_visited=False, or XLA-CPU outputs)
         # warn per compiled shape; donation is best-effort by design
         warnings.filterwarnings(
             "ignore", message="Some donated buffers were not usable"
         )
-        return _multi_hop_jit(
+        res = _multi_hop_jit(
             offsets, dst, frontier, visited, n_hops, cap, track_visited, lut
         )
+        if sp is not None:
+            ms.set_attr("hops", int(n_hops))
+            ms.set_attr("cap", int(cap))
+            ms.set_attr("track_visited", bool(track_visited))
+            ms.set_attr("device_sync_ms", round(obs.block_ready_ms(res), 3))
+        return res
 
 
 @partial(
@@ -492,6 +507,21 @@ class ClassedExpander:
         happens host-side from the known per-row degrees (the same
         O(edges) numpy accounting the packed CSR path already pays).
         """
+        from dgraph_tpu import obs
+
+        sp = obs.current_span()
+        if sp is not None:
+            # sampled: the classed hop program is the device-program
+            # granularity below the engine's `hop` span — class shape +
+            # heavy-bucket size explain which compiled program family ran
+            with sp.child("hop.program") as hs:
+                out_flat, seg_ptr = self._expand_rows(rows, degs, hs)
+            return out_flat, seg_ptr
+        return self._expand_rows(rows, degs, None)
+
+    def _expand_rows(
+        self, rows: np.ndarray, degs: np.ndarray, span
+    ) -> Tuple[np.ndarray, np.ndarray]:
         # ONE classification pass serves counts, caps and the mats —
         # this runs per level on the hot path, so no re-derivation
         rs, starts, deg_s, pos = self.class_sort(rows)
@@ -512,10 +542,19 @@ class ClassedExpander:
             mats.append(m)
             positions.append(pos[lo:hi])
         prog = self.program(caps, mode="materialize")
-        lanes, _total = prog(
+        lanes_dev, _total = prog(
             tuple(jnp.asarray(m) for m in mats), ()
         )
-        lanes = np.asarray(lanes)
+        if span is not None:
+            span.set_attr("rows", int(len(rows)))
+            span.set_attr("heavy_rows", int(n_heavy))
+            span.set_attr("caps", list(int(c) for c in caps))
+            from dgraph_tpu import obs
+
+            span.set_attr(
+                "device_sync_ms", round(obs.block_ready_ms(lanes_dev), 3)
+            )
+        lanes = np.asarray(lanes_dev)
         degs = np.asarray(degs)
         n = len(rows)
         seg_ptr = np.zeros(n + 1, dtype=np.int64)

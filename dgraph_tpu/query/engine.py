@@ -13,12 +13,11 @@ reference draws at the ServeTask boundary (SURVEY.md §2c).
 from __future__ import annotations
 
 import os
-import time as _time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from dgraph_tpu import gql, ops
+from dgraph_tpu import gql, obs, ops
 from dgraph_tpu.gql.ast import (
     FilterTree,
     Function,
@@ -126,6 +125,13 @@ class DeviceExpander:
         # same-(arena, predicate, direction) expansions from different
         # sessions sharing a snapshot merge into ONE dispatch
         self.hop_merger = None
+        # flight-recorder state (obs/spans.py): _span is the SAMPLED
+        # request's current hop span (None on the unsampled hot path —
+        # the branch every trace hook takes first), _route names the
+        # routing decision the last expansion took so the hop span can
+        # say WHERE the time went, not just how much
+        self._span = None
+        self._route = ""
 
     def _use_classed(self) -> bool:
         if self.fused_hop == "0":
@@ -137,6 +143,41 @@ class DeviceExpander:
         return jax.default_backend() == "cpu"
 
     def expand(
+        self, arena, src: np.ndarray, attr: str = "", reverse: bool = False
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Per-level expansion entry.  When the request is SAMPLED
+        (obs/spans.py), each call records one ``hop`` span carrying the
+        predicate, frontier size, edges traversed, the route the
+        expansion took (cache/merged/mesh/host/classed/inline/csr) and
+        the device-time split; the unsampled path branches away before
+        any span object exists."""
+        sp = obs.current_span()
+        if sp is None:  # unsampled hot path: zero allocations, async dispatch
+            return self._expand_cached(arena, src, attr, reverse)
+        st = self.engine.stats
+        e0, d0, h0 = st["edges"], st["device_expand_ms"], st["host_expand_ms"]
+        self._route = ""
+        with sp.child("hop") as hs:
+            self._span = hs
+            try:
+                out, seg_ptr = self._expand_cached(arena, src, attr, reverse)
+            finally:
+                self._span = None
+            hs.set_attr("pred", attr)
+            if reverse:
+                hs.set_attr("reverse", True)
+            hs.set_attr("n_src", int(len(src)))
+            hs.set_attr("edges", int(st["edges"] - e0))
+            hs.set_attr("route", self._route)
+            dm = st["device_expand_ms"] - d0
+            hm = st["host_expand_ms"] - h0
+            if dm:
+                hs.set_attr("device_ms", round(dm, 3))
+            if hm:
+                hs.set_attr("host_ms", round(hm, 3))
+        return out, seg_ptr
+
+    def _expand_cached(
         self, arena, src: np.ndarray, attr: str = "", reverse: bool = False
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Per-level expansion entry: tier-1 hop cache first (a repeat
@@ -164,6 +205,7 @@ class DeviceExpander:
             cached = hc.get(arena, attr, reverse, src, ver, key=hkey)
             if cached is not None:
                 self.engine.stats["edges"] += len(cached[0])
+                self._route = "cache"
                 return cached
         if (
             self.hop_merger is not None
@@ -171,6 +213,7 @@ class DeviceExpander:
             and len(src)
             and len(src) * arena.avg_degree >= self.engine.expand_device_min
         ):
+            self._route = "merged"
             out, seg_ptr = self.submit_hop(arena, src, attr, reverse)
         else:
             out, seg_ptr = self._expand_one(
@@ -213,32 +256,34 @@ class DeviceExpander:
         eng = self.engine
         n = len(src)
         if n == 0 or arena.n_edges == 0:
+            self._route = "empty"
             return _EMPTY, np.zeros(n + 1, dtype=np.int64)
         rows = arena.rows_for_uids_host(src)
         total = int(arena.degree_of_rows(rows).sum())
         if total == 0:
+            self._route = "empty"
             return _EMPTY, np.zeros(n + 1, dtype=np.int64)
         cap = ops.bucket(total)
         if attr and eng.arenas.use_mesh_for(arena):
             from dgraph_tpu.parallel.mesh import sharded_expand_segments
 
             sharded = eng.arenas.sharded_csr(attr, reverse=reverse)
-            t0 = _time.perf_counter()
-            out, seg_ptr = sharded_expand_segments(
-                eng.arenas.mesh, sharded, src, cap
-            )
+            self._route = "mesh"
+            with obs.stage(eng.stats, "device_expand_ms"):
+                out, seg_ptr = sharded_expand_segments(
+                    eng.arenas.mesh, sharded, src, cap
+                )
             eng.stats["edges"] += len(out)
-            eng.stats["device_expand_ms"] += (_time.perf_counter() - t0) * 1e3
             return out, seg_ptr
         if total < eng.expand_device_min:
             # small expansion: vectorized numpy over the host CSR mirror —
             # a device dispatch costs a transport round trip that dwarfs
             # the work (the size-adaptive routing the reference does
             # per-intersection, algo/uidlist.go:56-64, done per-level)
-            t0 = _time.perf_counter()
-            out, seg_ptr = arena.expand_host(rows)
+            self._route = "host"
+            with obs.stage(eng.stats, "host_expand_ms"):
+                out, seg_ptr = arena.expand_host(rows)
             eng.stats["edges"] += len(out)
-            eng.stats["host_expand_ms"] += (_time.perf_counter() - t0) * 1e3
             return out, seg_ptr
         # big single-device expansion.  The inline-head fast path (one
         # 32B row gather serves metadata + the first INLINE targets;
@@ -247,38 +292,54 @@ class DeviceExpander:
         # frontier, so those fall back to the order-agnostic CSR gather.
         valid_rows = rows[rows >= 0]
         ascending = bool(np.all(valid_rows[1:] > valid_rows[:-1]))
-        t0 = _time.perf_counter()
         if ascending and self._use_classed():
-            arena.ensure_device()  # re-upload after incremental deltas
-            ce = ops.classed_for_arena(arena)
-            out, seg_ptr = ce.expand_rows(rows, arena.degree_of_rows(rows))
-            eng.stats["device_expand_ms"] += (_time.perf_counter() - t0) * 1e3
+            self._route = "classed"
+            with obs.stage(eng.stats, "device_expand_ms"):
+                arena.ensure_device()  # re-upload after incremental deltas
+                ce = ops.classed_for_arena(arena)
+                out, seg_ptr = ce.expand_rows(
+                    rows, arena.degree_of_rows(rows)
+                )
             eng.stats["edges"] += len(out)
             return out, seg_ptr
         if ascending:
+            self._route = "inline"
             metap, ov_chunks = arena.inline_layout()
             B = ops.bucket(n)
             capov = ops.bucket(
                 max(1, int(arena.ov_chunk_degree_of_rows(rows).sum()))
             )
-            packed = np.asarray(  # one fetch: inline|ov|ovseg concatenated
-                _packed_expand_inline(
+            with obs.stage(eng.stats, "device_expand_ms"):
+                dev = _packed_expand_inline(
                     metap, ov_chunks, ops.pad_rows(rows, B), capov
                 )
-            )
-            eng.stats["device_expand_ms"] += (_time.perf_counter() - t0) * 1e3
+                if self._span is not None:
+                    # sampled: split pure device time from the host fetch
+                    # (the unsampled path stays dispatch-async — asarray
+                    # overlaps the compute with the host bookkeeping)
+                    self._span.set_attr(
+                        "device_sync_ms", round(obs.block_ready_ms(dev), 3)
+                    )
+                # one fetch: inline|ov|ovseg concatenated on device
+                packed = np.asarray(dev)
             from dgraph_tpu.query.chain import packed_inline_to_matrix
 
             out, seg_ptr = packed_inline_to_matrix(packed, B, capov, n)
             eng.stats["edges"] += len(out)
             return out, seg_ptr
-        arena.ensure_device()  # re-upload after incremental host deltas
-        packed = np.asarray(  # one fetch: out|seg concatenated on device
-            _packed_expand_csr(
-                arena.offsets, arena.dst, ops.pad_rows(rows, ops.bucket(n)), cap
+        self._route = "csr"
+        with obs.stage(eng.stats, "device_expand_ms"):
+            arena.ensure_device()  # re-upload after incremental host deltas
+            dev = _packed_expand_csr(
+                arena.offsets, arena.dst,
+                ops.pad_rows(rows, ops.bucket(n)), cap,
             )
-        )
-        eng.stats["device_expand_ms"] += (_time.perf_counter() - t0) * 1e3
+            if self._span is not None:
+                self._span.set_attr(
+                    "device_sync_ms", round(obs.block_ready_ms(dev), 3)
+                )
+            # one fetch: out|seg concatenated on device
+            packed = np.asarray(dev)
         out = packed[:total].astype(np.int64)
         seg = packed[cap : cap + total].astype(np.int64)
         counts = np.bincount(seg, minlength=n)
@@ -697,11 +758,10 @@ class QueryEngine:
         if child.chain_stash is None:
             from dgraph_tpu.query.chain import try_run_chain
 
-            t0 = _time.perf_counter()
-            try_run_chain(self, child, src, resolver)
             # failed attempts count too: planning cost must show up in
             # SOME bucket or the breakdown misleads
-            self.stats["chain_ms"] += (_time.perf_counter() - t0) * 1e3
+            with obs.stage(self.stats, "chain_ms"):
+                try_run_chain(self, child, src, resolver)
         if child.chain_stash is not None and child.chain_stash[0] == "light":
             _tag, dest, stash_src, n_edges = child.chain_stash
             child.chain_stash = None
@@ -1022,16 +1082,15 @@ class QueryEngine:
             return np.lexsort((key, owner)).astype(np.int64)
         import jax.numpy as jnp
 
-        t0 = _time.perf_counter()
-        cap = ops.bucket(n)
-        uids_pad = jnp.asarray(ops.pad_to(out, cap))
-        seg_pad = np.full(cap, -1, dtype=np.int32)
-        seg_pad[:n] = owner
-        ranks = ops.gather_ranks(va.src, va.ranks, uids_pad)
-        perm = np.asarray(
-            ops.segmented_sort_perm(jnp.asarray(seg_pad), ranks, bool(desc))
-        )
-        self.stats["device_order_ms"] += (_time.perf_counter() - t0) * 1e3
+        with obs.stage(self.stats, "device_order_ms"):
+            cap = ops.bucket(n)
+            uids_pad = jnp.asarray(ops.pad_to(out, cap))
+            seg_pad = np.full(cap, -1, dtype=np.int32)
+            seg_pad[:n] = owner
+            ranks = ops.gather_ranks(va.src, va.ranks, uids_pad)
+            perm = np.asarray(
+                ops.segmented_sort_perm(jnp.asarray(seg_pad), ranks, bool(desc))
+            )
         return perm[:n].astype(np.int64)  # padding sorts to the tail
 
     def _host_order_perm(

@@ -62,6 +62,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from dgraph_tpu import obs
 from dgraph_tpu.sched.cohort import (
     Cohort,
     HopMerger,
@@ -207,6 +208,29 @@ class CohortScheduler:
             else None
         )
         req = SchedRequest(parsed, debug=debug, deadline=deadline, key=key)
+        sp = obs.current_span()
+        if sp is not None:
+            # sampled: carry the request's root across the thread hop to
+            # the flush worker, and open the queue-wait span HERE — the
+            # admission→execution gap is exactly the time the legacy
+            # latency map filed under an undifferentiated "processing"
+            req.span = sp
+            req.queue_span = sp.child("sched.queue")
+        try:
+            self._admit(req, sig, key)
+        except SchedOverloadError:
+            # the queue-wait span opened above must land in the trace
+            # with the shed verdict, not leak unfinished
+            req.end_queue_wait("shed_overload")
+            raise
+        result, stats = req.wait()
+        if rc_key is not None:
+            # sharing the response dict is safe by the singleflight
+            # argument: handlers only encode results, never mutate them
+            rc.put(rc_key, sig[0], result, stats)
+        return result, stats
+
+    def _admit(self, req: SchedRequest, sig: tuple, key) -> None:
         with self._cond:
             if self._stopped:
                 raise SchedOverloadError("scheduler stopped")
@@ -232,12 +256,6 @@ class CohortScheduler:
                 self._last_arrival = time.monotonic()
                 SCHED_QUEUE_DEPTH.set(self._depth)
                 self._cond.notify_all()
-        result, stats = req.wait()
-        if rc_key is not None:
-            # sharing the response dict is safe by the singleflight
-            # argument: handlers only encode results, never mutate them
-            rc.put(rc_key, sig[0], result, stats)
-        return result, stats
 
     # -- flush workers -----------------------------------------------------
 
@@ -334,6 +352,20 @@ class CohortScheduler:
         n_dup = len(live) - len(leaders)
         if n_dup:
             SCHED_COALESCED.add(n_dup)
+        # flight recorder: ONE shared span per cohort flush, parented to
+        # the first sampled member's trace; every other sampled member's
+        # engine span LINKS to it instead of pretending to own it — so
+        # cross-session merging stops hiding where time went without
+        # lying about who did the work
+        flush_span = None
+        for r in live:
+            if r.span is not None:
+                flush_span = r.span.child("sched.flush")
+                flush_span.set_attr("reason", reason)
+                flush_span.set_attr("occupancy", len(cohort.reqs))
+                flush_span.set_attr("leaders", len(leaders))
+                flush_span.set_attr("coalesced", n_dup)
+                break
         # publish keyed leaders so identical arrivals during execution
         # attach instead of re-running (skip keys another flush already
         # owns — its version differs, or it registered first)
@@ -352,7 +384,7 @@ class CohortScheduler:
             fail.point("sched.flush")
             with srv._engine_lock.read():  # ONE read acquisition per cohort
                 if len(leaders) == 1:
-                    self._run_one(leaders[0], merger)
+                    self._run_one(leaders[0], merger, flush_span)
                 else:
                     # fresh threads per flush, not a persistent pool:
                     # spawn cost (~100µs each) is noise next to cohort
@@ -361,14 +393,15 @@ class CohortScheduler:
                     # across concurrent flushes
                     threads = [
                         threading.Thread(
-                            target=self._run_one, args=(req, merger),
+                            target=self._run_one,
+                            args=(req, merger, flush_span),
                             name="dgraph-cohort", daemon=True,
                         )
                         for req in leaders[1:]
                     ]
                     for t in threads:
                         t.start()
-                    self._run_one(leaders[0], merger)
+                    self._run_one(leaders[0], merger, flush_span)
                     for t in threads:
                         t.join()
                 for k, followers in dups.items():
@@ -383,7 +416,7 @@ class CohortScheduler:
                         elif isinstance(lead.error, SchedDeadlineError):
                             # the leader ran out of budget but this
                             # duplicate still has some: run it (rare)
-                            self._run_one(req, merger)
+                            self._run_one(req, merger, flush_span)
                         else:
                             req.fail(lead.error)
         except BaseException as e:  # noqa: BLE001 — lock failure etc.: fail, never hang
@@ -405,6 +438,11 @@ class CohortScheduler:
             with self._cond:
                 self._depth -= len(live) + n_att
                 SCHED_QUEUE_DEPTH.set(self._depth)
+            if flush_span is not None:
+                flush_span.set_attr(
+                    "merged_hops", merger.merged_dispatches
+                )
+                flush_span.finish()
 
     def _complete_follower(self, req, lead, merger) -> None:
         """Deal a singleflight leader's outcome to an attached twin."""
@@ -427,7 +465,9 @@ class CohortScheduler:
             f"({(now - req.enqueued) * 1e3:.1f}ms in cohort)"
         ))
 
-    def _run_one(self, req: SchedRequest, merger: HopMerger) -> None:
+    def _run_one(
+        self, req: SchedRequest, merger: HopMerger, flush_span=None
+    ) -> None:
         from dgraph_tpu.query import outputnode
         from dgraph_tpu.query.engine import QueryEngine
 
@@ -438,15 +478,28 @@ class CohortScheduler:
                 # lock (a long write was in front of us): shed, don't run
                 self._shed_deadline(req, time.monotonic())
                 return
-            eng = QueryEngine(srv.store, arenas=srv.engine.arenas)
-            eng.chain_threshold = srv.engine.chain_threshold
-            eng.expander.hop_merger = merger
-            eng.dump_shapes = bool(srv.dumpsg_path)
-            token = outputnode.DEBUG_UIDS.set(req.debug)
-            try:
-                out = eng.run_parsed(req.parsed)
-            finally:
-                outputnode.DEBUG_UIDS.reset(token)
+            req.end_queue_wait("run")
+            # re-root this worker thread under the admitting request's
+            # trace: the engine span parents to the REQUEST (it is that
+            # query's execution) and LINKS to the shared cohort-flush
+            # span that scheduled it — merged work attributed without
+            # being claimed twice
+            es = obs.NOOP
+            if req.span is not None:
+                es = req.span.child("engine")
+                if flush_span is not None:
+                    es.link(flush_span)
+            with es:
+                eng = QueryEngine(srv.store, arenas=srv.engine.arenas)
+                eng.chain_threshold = srv.engine.chain_threshold
+                eng.expander.hop_merger = merger
+                eng.dump_shapes = bool(srv.dumpsg_path)
+                token = outputnode.DEBUG_UIDS.set(req.debug)
+                try:
+                    out = eng.run_parsed(req.parsed)
+                finally:
+                    outputnode.DEBUG_UIDS.reset(token)
+                es.set_attr("edges", eng.stats.get("edges", 0))
             if srv.dumpsg_path and eng.last_dump:
                 srv._dump_subgraphs(eng.last_dump)
             req.complete(out, dict(eng.stats))

@@ -461,9 +461,14 @@ class GrpcServer:
             timeout_s = None
         if timeout_s is not None and timeout_s > 1e8:
             timeout_s = None  # "no deadline" sentinel from grpcio
+        # W3C trace propagation over the gRPC leg: traceparent rides
+        # invocation metadata (keys are lowercased by grpc); malformed
+        # values parse to None and are ignored, never an error
+        tctx = self._md_trace_ctx(context)
         try:
             out = self._server.run_query(text, vars_ or None,
-                                         timeout_s=timeout_s)
+                                         timeout_s=timeout_s,
+                                         trace_ctx=tctx)
         except Exception as e:
             from dgraph_tpu.cluster.peerclient import StaleUnavailableError
             from dgraph_tpu.models.durability import StorageFaultError
@@ -509,8 +514,21 @@ class GrpcServer:
     def _check(self, req: bytes, context):
         return encode_version()
 
+    def _md_trace_ctx(self, context):
+        """Incoming traceparent from gRPC metadata (None on anything
+        malformed or absent — same contract as the HTTP header)."""
+        from dgraph_tpu import obs
+
+        try:
+            md = dict(context.invocation_metadata())
+        except Exception:  # noqa: BLE001 — metadata is optional
+            md = {}
+        return obs.parse_traceparent(md.get("traceparent"))
+
     def _assign(self, req: bytes, context):
         import grpc
+
+        from dgraph_tpu import obs
 
         n = decode_num(req)
         if n <= 0:
@@ -518,36 +536,45 @@ class GrpcServer:
         from dgraph_tpu.models.durability import ReadOnlyError, StorageFaultError
 
         srv = self._server
-        try:
-            # read-only admission, same gate as the HTTP mutation path: a
-            # latched disk fault may have left a torn WAL tail, and an
-            # append landing after it would vanish from replay — the
-            # handed-out lease would be re-issued after restart
-            ro = getattr(srv.store, "storage_readonly", None)
-            if ro is not None and ro():
-                st = srv.store.health
-                raise ReadOnlyError(
-                    "storage is in read-only mode "
-                    f"({st.last_site}: {st.last_error}); "
-                    "uid leasing shed until the re-arm probe clears",
-                    retry_after=st.probe_interval_s,
-                )
-            # the lease journals to the WAL: take the engine write lock
-            # like every other journaling path, so a concurrent
-            # snapshotter seal (segment swap) or re-arm reopen can never
-            # interleave with this append
-            with srv._engine_lock.write():
-                uids = srv.store.uids.fresh(n)
-            # uid handouts must be DURABLE before the client sees them
-            # (a crash re-issuing a uid aliases entities); under group
-            # commit the fsync lives in this barrier, OUTSIDE the lock,
-            # shared with concurrent writers
-            barrier = getattr(srv.store, "sync_barrier", None)
-            if barrier is not None:
-                barrier()
-        except StorageFaultError as e:
-            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-        return encode_assigned_ids(uids[0], uids[-1])
+        # gRPC leg of the distributed trace: a sampled caller's uid
+        # lease records this node's half under the same trace_id (the
+        # HTTP /assign-uids endpoint's twin)
+        with obs.server_span(
+            "peer.assign-uids", self._md_trace_ctx(context)
+        ) as ss:
+            if srv.cluster is not None:
+                ss.set_attr("node", srv.cluster.node_id)
+            try:
+                # read-only admission, same gate as the HTTP mutation
+                # path: a latched disk fault may have left a torn WAL
+                # tail, and an append landing after it would vanish from
+                # replay — the handed-out lease would be re-issued after
+                # restart
+                ro = getattr(srv.store, "storage_readonly", None)
+                if ro is not None and ro():
+                    st = srv.store.health
+                    raise ReadOnlyError(
+                        "storage is in read-only mode "
+                        f"({st.last_site}: {st.last_error}); "
+                        "uid leasing shed until the re-arm probe clears",
+                        retry_after=st.probe_interval_s,
+                    )
+                # the lease journals to the WAL: take the engine write
+                # lock like every other journaling path, so a concurrent
+                # snapshotter seal (segment swap) or re-arm reopen can
+                # never interleave with this append
+                with srv._engine_lock.write():
+                    uids = srv.store.uids.fresh(n)
+                # uid handouts must be DURABLE before the client sees
+                # them (a crash re-issuing a uid aliases entities);
+                # under group commit the fsync lives in this barrier,
+                # OUTSIDE the lock, shared with concurrent writers
+                barrier = getattr(srv.store, "sync_barrier", None)
+                if barrier is not None:
+                    barrier()
+            except StorageFaultError as e:
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            return encode_assigned_ids(uids[0], uids[-1])
 
     # -- Worker plane (the reference's internal gRPC port) ----------------
 
@@ -573,15 +600,25 @@ class GrpcServer:
             context.abort(grpc.StatusCode.UNIMPLEMENTED, "not clustered")
         if not self._cluster_ok(context):
             context.abort(grpc.StatusCode.PERMISSION_DENIED, "bad cluster secret")
+        from dgraph_tpu import obs
         from dgraph_tpu.utils.metrics import NUM_GRPC_RAFT
 
         NUM_GRPC_RAFT.add(1)
-        try:
-            group, frame = unframe_raft(decode_payload(req))
-            cluster.deliver(group, frame)
-        except Exception as e:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        return encode_payload(b"")
+        # raft frames from SENDER LOOPS carry no trace context, but a
+        # frame sent from a traced call path does — record its leg here
+        # so the gRPC transport matches the HTTP /raft endpoint's story
+        with obs.server_span(
+            "peer.raft-message", self._md_trace_ctx(context)
+        ) as ss:
+            # duck clusters in tests may not carry an id
+            ss.set_attr("node", getattr(cluster, "node_id", ""))
+            try:
+                group, frame = unframe_raft(decode_payload(req))
+                ss.set_attr("group", group)
+                cluster.deliver(group, frame)
+            except Exception as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            return encode_payload(b"")
 
 
 # ----------------------------------------------------------- client pool

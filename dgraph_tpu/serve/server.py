@@ -15,12 +15,15 @@ through one ServeTask boundary per group (SURVEY.md §2c).
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from dgraph_tpu import obs
 from dgraph_tpu.models.durability import ReadOnlyError, StorageFaultError
 from dgraph_tpu.models.store import PostingStore
 from dgraph_tpu.query.engine import QueryEngine
@@ -237,22 +240,37 @@ class DgraphServer:
         variables: Optional[dict] = None,
         debug: bool = False,
         timeout_s: Optional[float] = None,
+        trace_ctx=None,
     ) -> dict:
         """The ParseQueryAndMutation → ProcessWithMutation → encode path
         with the reference's latency breakdown (query/query.go:102).
 
         ``timeout_s`` is the caller's remaining budget (gRPC deadline /
         X-Dgraph-Timeout header): a scheduled request past it sheds with
-        SchedDeadlineError instead of sitting in a cohort queue."""
+        SchedDeadlineError instead of sitting in a cohort queue.
+
+        ``trace_ctx`` (obs.TraceContext) is the caller's incoming W3C
+        traceparent, if any: a sampled upstream makes this request's
+        flight-recorder root join its trace.  When sampled, the legacy
+        Latency stage marks are mirrored as ``parsing``/``processing``
+        spans under the root — the response's latency map renders
+        exactly as before, the trace just stops being flat."""
         from dgraph_tpu import gql
 
         NUM_QUERIES.add(1)
         PENDING_QUERIES.add(1)
         tr = self.tracer.begin()
         lat = Latency()
-        t0 = __import__("time").monotonic()
+        t0 = time.monotonic()
+        root = obs.start_request("query", trace_ctx)
+        if root is not None:
+            root.set_attr("query", text[:200])
+            if self.cluster is not None:
+                root.set_attr("node", self.cluster.node_id)
+            root.__enter__()  # paired with __exit__ in the finally below
         try:
-            parsed = gql.parse(text, variables)
+            with obs.child("parsing"):
+                parsed = gql.parse(text, variables)
             lat.record_parsing()
             tr.printf("parsed: %d queries, mutation=%s", len(parsed.queries),
                       parsed.mutation is not None)
@@ -272,38 +290,43 @@ class DgraphServer:
             out: dict = {}
             from dgraph_tpu.query import outputnode
 
-            if self.scheduler is not None and parsed.mutation is None:
-                # read-only: ride a cohort (the scheduler's member thread
-                # sets DEBUG_UIDS for the encode; writes and profiled
-                # runs keep the exclusive path below, untouched).  The
-                # key makes equal requests singleflight-coalescible AND
-                # tier-2 result-cacheable: a repeat of an executed key
-                # over the same store snapshot returns from the cache
-                # before admission (sched/scheduler.py, cache/result.py;
-                # DGRAPH_TPU_CACHE=0 restores today's path exactly).
-                vkey = (
-                    json.dumps(variables, sort_keys=True) if variables else ""
-                )
-                result, stats = self.scheduler.run(
-                    parsed, debug=debug, timeout_s=timeout_s,
-                    key=(text, vkey, debug),
-                )
-                out.update(result)
-            else:
-                debug_token = outputnode.DEBUG_UIDS.set(debug)
-                try:
-                    stats = self._run_locked(parsed, out)
-                finally:
-                    outputnode.DEBUG_UIDS.reset(debug_token)
-                if parsed.mutation is not None:
-                    # group-commit durability barrier, OUTSIDE the write
-                    # lock: the mutation is applied and journaled; the
-                    # ack (this response) waits for a shared fsync that
-                    # concurrent writers amortize (no-op unless
-                    # enable_group_commit ran — see __init__)
-                    barrier = getattr(self.store, "sync_barrier", None)
-                    if barrier is not None:
-                        barrier()
+            with obs.child("processing"):
+                if self.scheduler is not None and parsed.mutation is None:
+                    # read-only: ride a cohort (the scheduler's member
+                    # thread sets DEBUG_UIDS for the encode; writes and
+                    # profiled runs keep the exclusive path below,
+                    # untouched).  The key makes equal requests
+                    # singleflight-coalescible AND tier-2
+                    # result-cacheable: a repeat of an executed key over
+                    # the same store snapshot returns from the cache
+                    # before admission (sched/scheduler.py,
+                    # cache/result.py; DGRAPH_TPU_CACHE=0 restores
+                    # today's path exactly).
+                    vkey = (
+                        json.dumps(variables, sort_keys=True)
+                        if variables else ""
+                    )
+                    result, stats = self.scheduler.run(
+                        parsed, debug=debug, timeout_s=timeout_s,
+                        key=(text, vkey, debug),
+                    )
+                    out.update(result)
+                else:
+                    debug_token = outputnode.DEBUG_UIDS.set(debug)
+                    try:
+                        stats = self._run_locked(parsed, out)
+                    finally:
+                        outputnode.DEBUG_UIDS.reset(debug_token)
+                    if parsed.mutation is not None:
+                        # group-commit durability barrier, OUTSIDE the
+                        # write lock: the mutation is applied and
+                        # journaled; the ack (this response) waits for a
+                        # shared fsync that concurrent writers amortize
+                        # (no-op unless enable_group_commit ran — see
+                        # __init__)
+                        barrier = getattr(self.store, "sync_barrier", None)
+                        if barrier is not None:
+                            barrier()
             lat.record_processing()
             tr.printf("processed")
             # json encode happens in the handler; pre-record here so the
@@ -326,12 +349,29 @@ class DgraphServer:
                     for k, v in stats.items()
                 }
             return out
+        except BaseException as e:
+            if root is not None:
+                root.set_attr("error", type(e).__name__)
+            raise
         finally:
             PENDING_QUERIES.add(-1)
-            QUERY_LATENCY.observe(__import__("time").monotonic() - t0)
+            dur = time.monotonic() - t0
+            trace_id = root.trace_id if root is not None else None
+            if root is not None:
+                root.__exit__(None, None, None)  # publish to the ring
+            # slow-query tail sampling is independent of the head
+            # sampler: an offender at ratio 0 still gets a structured
+            # log line and a synthetic trace (obs/spans.py note_slow) —
+            # run it BEFORE the histogram so the tail bucket's exemplar
+            # can point at the synthetic trace too
+            slow_tid = obs.get_recorder().note_slow(text, dur, trace_id)
+            # the latency histogram carries the trace as an OpenMetrics
+            # exemplar (utils/metrics.py): the bucket this request
+            # landed in links straight to /debug/traces/<id>
+            QUERY_LATENCY.observe(dur, trace_id=trace_id or slow_tid)
             self.tracer.finish(tr, "query", text[:120])
 
-    _dump_seq = __import__("itertools").count()
+    _dump_seq = itertools.count()
 
     def _dump_subgraphs(self, dump) -> None:
         import datetime as _dt
@@ -480,12 +520,56 @@ def _make_handler(srv: DgraphServer):
                     stats = _store_stats(srv.store)
                 stats["qcache"] = _qcache_stats(srv)
                 self._reply(200, json.dumps(stats).encode())
-            elif path == "/debug/prometheus_metrics":
-                self._reply(200, metrics.prometheus_text().encode(), "text/plain")
+            elif path in ("/metrics", "/debug/prometheus_metrics"):
+                # /metrics is the standard scrape alias; the debug path
+                # stays for existing scrape configs.  Content negotiation:
+                # a scraper asking for OpenMetrics gets histogram bucket
+                # EXEMPLARS (trace_id links into /debug/traces) + # EOF;
+                # everyone else gets the classic format under its proper
+                # versioned content type.
+                accept = self.headers.get("Accept", "")
+                if "application/openmetrics-text" in accept:
+                    self._reply(
+                        200,
+                        metrics.openmetrics_text().encode(),
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8",
+                    )
+                else:
+                    self._reply(
+                        200,
+                        metrics.prometheus_text().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
             elif path == "/debug/requests":
                 if not srv.expose_trace:
                     return self._err(403, "tracing not exposed")
                 self._reply(200, json.dumps(srv.tracer.recent()).encode())
+            elif path == "/debug/traces" or path.startswith("/debug/traces/"):
+                # the flight-recorder ring (obs/spans.py): listing, one
+                # trace's merged span tree, or the Chrome trace_event
+                # export (?format=chrome) for chrome://tracing / Perfetto
+                if not srv.expose_trace:
+                    return self._err(403, "tracing not exposed")
+                rec = obs.get_recorder()
+                if path == "/debug/traces":
+                    self._reply(200, json.dumps(rec.traces()).encode())
+                else:
+                    tid = path.rsplit("/", 1)[1]
+                    t = rec.trace(tid)
+                    if t is None:
+                        return self._err(404, "no such trace")
+                    qs = parse_qs(u.query)
+                    if qs.get("format", [""])[0] == "chrome":
+                        t = obs.chrome_trace(t)
+                    self._reply(200, json.dumps(t).encode())
+            elif path == "/debug/slow_queries":
+                if not srv.expose_trace:
+                    return self._err(403, "tracing not exposed")
+                self._reply(
+                    200,
+                    json.dumps(obs.get_recorder().slow_queries()).encode(),
+                )
             elif path == "/admin/export":
                 try:
                     with srv._export_lock, srv._engine_lock.read():
@@ -542,21 +626,32 @@ def _make_handler(srv: DgraphServer):
                 qs = parse_qs(u.query)  # parse_qs already percent-decodes
                 name = qs.get("name", [""])[0]
                 since = int(qs.get("since", ["-1"])[0])
-                gid = srv.cluster.conf.belongs_to(name)
-                g = srv.cluster.groups.get(gid)
-                if g is None:
-                    return self._err(404, f"group {gid} not served here")
-                from dgraph_tpu.cluster.replica import pred_to_bytes
+                # server half of the distributed trace: a sampled remote
+                # reader's traceparent makes THIS node record its leg of
+                # the snapshot serve under the same trace_id
+                tctx = obs.parse_traceparent(self.headers.get("Traceparent"))
+                with obs.server_span("peer.pred-snapshot", tctx) as ss:
+                    ss.set_attr("node", srv.cluster.node_id)
+                    ss.set_attr("pred", name)
+                    gid = srv.cluster.conf.belongs_to(name)
+                    g = srv.cluster.groups.get(gid)
+                    if g is None:
+                        return self._err(404, f"group {gid} not served here")
+                    from dgraph_tpu.cluster.replica import pred_to_bytes
 
-                with g._lock:
-                    ver = g.pred_version(name)
-                    body = b"" if ver == since else pred_to_bytes(g.store, name)
-                self.send_response(204 if ver == since else 200)
-                self.send_header("X-Pred-Version", str(ver))
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                if ver != since:
-                    self.wfile.write(body)
+                    with g._lock:
+                        ver = g.pred_version(name)
+                        body = (
+                            b"" if ver == since
+                            else pred_to_bytes(g.store, name)
+                        )
+                    ss.set_attr("bytes", len(body))
+                    self.send_response(204 if ver == since else 200)
+                    self.send_header("X-Pred-Version", str(ver))
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    if ver != since:
+                        self.wfile.write(body)
             elif path == "/predlist":
                 if srv.cluster is None:
                     return self._err(404, "not clustered")
@@ -602,19 +697,24 @@ def _make_handler(srv: DgraphServer):
                     return self._err(403, "bad cluster secret")
                 from dgraph_tpu.cluster.raft import NotLeaderError
 
-                try:
-                    want = int(raw or b"1")
-                    if want < 0:  # negative = reserve an explicit uid
-                        start, end = srv.cluster.reserve_local(-want)
-                    else:
-                        start, end = srv.cluster.assign_local(want)
-                except NotLeaderError as e:
-                    return self._reply(409, (e.leader or "").encode(), "text/plain")
-                except Exception as e:
-                    return self._err(400, str(e))
-                return self._reply(
-                    200, json.dumps({"start": start, "end": end}).encode()
-                )
+                tctx = obs.parse_traceparent(self.headers.get("Traceparent"))
+                with obs.server_span("peer.assign-uids", tctx) as ss:
+                    ss.set_attr("node", srv.cluster.node_id)
+                    try:
+                        want = int(raw or b"1")
+                        if want < 0:  # negative = reserve an explicit uid
+                            start, end = srv.cluster.reserve_local(-want)
+                        else:
+                            start, end = srv.cluster.assign_local(want)
+                    except NotLeaderError as e:
+                        return self._reply(
+                            409, (e.leader or "").encode(), "text/plain"
+                        )
+                    except Exception as e:
+                        return self._err(400, str(e))
+                    return self._reply(
+                        200, json.dumps({"start": start, "end": end}).encode()
+                    )
             if u.path == "/join":
                 # runtime membership: a new server announces itself
                 # (grpc JoinCluster analog, draft.go:1049)
@@ -651,13 +751,23 @@ def _make_handler(srv: DgraphServer):
                     return self._reply(200, b"{}")
                 from dgraph_tpu.cluster.raft import NotLeaderError
 
-                try:
-                    srv.cluster.propose_local(gid, raw)
-                except NotLeaderError as e:
-                    return self._reply(409, (e.leader or "").encode(), "text/plain")
-                except Exception as e:
-                    return self._err(500, str(e))
-                return self._reply(200, b"{}")
+                # the forwarded-proposal leg of a distributed trace: a
+                # sampled forwarder's traceparent lands this node's
+                # commit work in the same trace
+                tctx = obs.parse_traceparent(self.headers.get("Traceparent"))
+                with obs.server_span("peer.raft-propose", tctx) as ss:
+                    ss.set_attr("node", srv.cluster.node_id)
+                    ss.set_attr("group", gid)
+                    try:
+                        srv.cluster.propose_local(gid, raw)
+                    except NotLeaderError as e:
+                        ss.set_attr("outcome", "not_leader")
+                        return self._reply(
+                            409, (e.leader or "").encode(), "text/plain"
+                        )
+                    except Exception as e:
+                        return self._err(500, str(e))
+                    return self._reply(200, b"{}")
             body = self.rfile.read(n).decode("utf-8", "replace")
             if u.path == "/query":
                 qs = parse_qs(u.query)
@@ -672,8 +782,14 @@ def _make_handler(srv: DgraphServer):
                         timeout_s = float(tmo_hdr) if tmo_hdr else None
                     except ValueError:
                         timeout_s = None
+                    # a malformed traceparent parses to None — an
+                    # attacker-controlled header must never 500 a query
+                    tctx = obs.parse_traceparent(
+                        self.headers.get("Traceparent")
+                    )
                     out = srv.run_query(
-                        body, variables, debug=debug, timeout_s=timeout_s
+                        body, variables, debug=debug, timeout_s=timeout_s,
+                        trace_ctx=tctx,
                     )
                     accept = self.headers.get("Accept", "")
                     if "application/protobuf" in accept or "application/x-protobuf" in accept:

@@ -9,7 +9,7 @@ queries/proposals, per-predicate mutation counts (task.go PredicateStats).
 from __future__ import annotations
 
 import threading
-from typing import Dict
+from typing import Dict, Optional
 
 
 class Counter:
@@ -133,9 +133,20 @@ class Histogram:
     """Fixed-bucket histogram with Prometheus `_bucket{le=...}` / `_sum` /
     `_count` exposition (the prometheus client_golang Histogram shape; the
     reference bridges expvar and loses distributions — queue-wait and
-    end-to-end latency need percentiles, not means)."""
+    end-to-end latency need percentiles, not means).
 
-    __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_lock")
+    Buckets optionally carry an OpenMetrics EXEMPLAR — the last
+    (trace_id, value, wall timestamp) that landed in them — so the
+    p99 bucket of ``dgraph_query_latency_seconds`` links straight to a
+    trace in the flight-recorder ring (``/debug/traces/<id>``).
+    Exemplars render only in the OpenMetrics exposition
+    (``openmetrics_text``); the classic text format has no syntax for
+    them."""
+
+    __slots__ = (
+        "name", "buckets", "_counts", "_sum", "_count", "_exemplars",
+        "_lock",
+    )
 
     def __init__(self, name: str, buckets):
         self.name = name
@@ -144,11 +155,13 @@ class Histogram:
             raise ValueError("histogram needs at least one bucket bound")
         # per-bucket (non-cumulative) counts; +Inf bucket is the tail slot
         self._counts = [0] * (len(self.buckets) + 1)
+        # per-bucket last exemplar: (trace_id, value, wall_ts) or None
+        self._exemplars = [None] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
         from bisect import bisect_left
 
         i = bisect_left(self.buckets, v)
@@ -156,6 +169,18 @@ class Histogram:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if trace_id:
+                import time as _t
+
+                # wall timestamp STORED, never used in interval math —
+                # OpenMetrics exemplar timestamps are epoch seconds
+                self._exemplars[i] = (trace_id, v, _t.time())
+
+    def exemplars(self):
+        """Per-bucket (trace_id, value, wall_ts) snapshot, aligned with
+        buckets + [+Inf]."""
+        with self._lock:
+            return list(self._exemplars)
 
     def snapshot(self):
         """(cumulative bucket counts aligned with self.buckets + [+Inf],
@@ -275,6 +300,44 @@ class MetricsRegistry:
             lines.append(f"{h.name}_sum {s:g}")
             lines.append(f"{h.name}_count {c}")
         return "\n".join(lines) + "\n"
+
+    def openmetrics_text(self) -> str:
+        """OpenMetrics exposition: the classic body plus histogram
+        bucket EXEMPLARS (``# {trace_id="..."} value timestamp``) and
+        the mandatory ``# EOF`` terminator.  Served when a scraper
+        negotiates ``application/openmetrics-text`` on /metrics —
+        exemplars are how ``dgraph_query_latency_seconds`` buckets link
+        to live traces in the flight-recorder ring.  Series names match
+        the classic exposition exactly (no ``_total`` re-suffixing), so
+        dashboards keep working across the negotiation boundary."""
+        classic = self.prometheus_text()
+        with self._lock:
+            histograms = list(self._histograms.values())
+        # keyed by the bucket-line PREFIX (name + le label), never the
+        # count: the classic body and the exemplar snapshot are taken at
+        # different instants, and a concurrent observe() between them
+        # must not strip exemplars from every bucket it bumped
+        ex_by_prefix: Dict[str, str] = {}
+        for h in histograms:
+            exemplars = h.exemplars()
+            bounds = [f"{b:g}" for b in h.buckets] + ["+Inf"]
+            for bound, ex in zip(bounds, exemplars):
+                if ex is None:
+                    continue
+                trace_id, v, ts = ex
+                ex_by_prefix[f'{h.name}_bucket{{le="{bound}"}} '] = (
+                    f' # {{trace_id="{trace_id}"}} {v:g} {ts:.3f}'
+                )
+        out = []
+        for line in classic.splitlines():
+            if "_bucket{" in line:
+                cut = line.index("} ") + 2
+                suffix = ex_by_prefix.get(line[:cut])
+                if suffix is not None:
+                    line += suffix
+            out.append(line)
+        out.append("# EOF")
+        return "\n".join(out) + "\n"
 
 
 # Global registry with the reference's standard counter set pre-named
@@ -402,6 +465,17 @@ WAL_BYTES = metrics.gauge("dgraph_wal_bytes")
 WAL_SEGMENTS = metrics.gauge("dgraph_wal_sealed_segments")
 GROUP_COMMIT_SYNCS = metrics.counter("dgraph_group_commit_syncs_total")
 GROUP_COMMIT_WRITES = metrics.counter("dgraph_group_commit_writes_total")
+
+
+# flight recorder (dgraph_tpu/obs/): SPANS_RECORDED counts every Span
+# object constructed — the overhead guard's proof that the unsampled
+# hot path allocates none (tests assert a ZERO delta at ratio 0, a
+# property a tracemalloc probe could only suggest); TRACES_RECORDED is
+# the ring intake rate; SLOW_QUERIES counts tail-sampled offenders
+# (DGRAPH_TPU_SLOW_MS) independently of head sampling.
+SPANS_RECORDED = metrics.counter("dgraph_trace_spans_total")
+TRACES_RECORDED = metrics.counter("dgraph_traces_recorded_total")
+SLOW_QUERIES = metrics.counter("dgraph_slow_queries_total")
 
 
 def note_swallowed(site: str, exc: BaseException) -> None:

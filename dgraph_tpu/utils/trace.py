@@ -8,7 +8,6 @@ plus the client-visible latency map {parsing, processing, json}
 
 from __future__ import annotations
 
-import random
 import threading
 import time
 from collections import deque
@@ -86,15 +85,30 @@ class RequestTrace:
 
 class Tracer:
     """Sampled tracing, ratio as in --trace (cmd/dgraph/main.go:250-255).
-    Finished traces are kept in a bounded ring served at /debug/requests."""
+    Finished traces are kept in a bounded ring served at /debug/requests.
 
-    def __init__(self, ratio: float = 0.0, keep: int = 64):
+    Sampling goes through an OWNED seeded sampler (obs.spans.Sampler —
+    one implementation of the discipline, shared with the flight
+    recorder's head sampler) instead of the global ``random`` module:
+    deterministic under a pinned ``seed`` / ``DGRAPH_TPU_TRACE_SEED``,
+    thread-safe, and decoupled from every other consumer of the
+    process-wide random stream."""
+
+    def __init__(self, ratio: float = 0.0, keep: int = 64,
+                 seed: Optional[int] = None):
+        # lazy import: utils/__init__ imports this module, and obs.spans
+        # imports utils submodules — binding at call time keeps the
+        # package import order a non-issue
+        from dgraph_tpu.obs.spans import Sampler
+
         self.ratio = ratio
+        self._sampler = Sampler(ratio=ratio, seed=seed)
         self._done: Deque[dict] = deque(maxlen=keep)
         self._lock = threading.Lock()
 
     def begin(self) -> RequestTrace:
-        return RequestTrace(self.ratio > 0 and random.random() < self.ratio)
+        self._sampler.ratio = self.ratio  # tests tweak .ratio live
+        return RequestTrace(self._sampler.decide())
 
     def finish(self, tr: RequestTrace, family: str, title: str) -> None:
         if not tr.active:

@@ -19,6 +19,7 @@ from dgraph_tpu.analysis.rules import (
     HostSyncInJit,
     NakedAtomicWrite,
     NakedPeerRpc,
+    NakedStageTiming,
     RecompileHazard,
     SwallowedException,
     WallClockDuration,
@@ -362,6 +363,111 @@ def test_naked_atomic_write_clean_counterexamples():
     """)
     assert check_source(
         sealed, [NakedAtomicWrite()], path="dgraph_tpu/models/wal.py"
+    ) == []
+
+
+def test_naked_stage_timing_bracketing_flagged_in_serving_dirs():
+    # the canonical bug: t0 = perf_counter() ... elapsed = pc() - t0
+    src = textwrap.dedent("""
+        import time as _time
+
+        def expand(self, rows):
+            t0 = _time.perf_counter()
+            out = do_expand(rows)
+            self.stats["ms"] += (_time.perf_counter() - t0) * 1e3
+            return out
+    """)
+    assert _ids(
+        check_source(
+            src, [NakedStageTiming()], path="dgraph_tpu/query/newexec.py"
+        )
+    ) == ["naked-stage-timing"]
+    # direct-call form without an intermediate name
+    inline = textwrap.dedent("""
+        import time
+
+        def handle(self):
+            start = time.perf_counter_ns()
+            serve()
+            return time.perf_counter_ns() - start
+    """)
+    assert _ids(
+        check_source(
+            inline, [NakedStageTiming()], path="dgraph_tpu/serve/handler.py"
+        )
+    ) == ["naked-stage-timing"]
+
+
+def test_naked_stage_timing_counterexamples_clean():
+    # the span API is the sanctioned home of the raw clock reads
+    inside = textwrap.dedent("""
+        import time
+
+        class _Stage:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, et, ev, tb):
+                self.stats[self.key] += (time.perf_counter() - self.t0) * 1e3
+    """)
+    assert check_source(
+        inside, [NakedStageTiming()], path="dgraph_tpu/obs/spans.py"
+    ) == []
+    # utils/trace.py (the legacy Latency marks) is exempt by design
+    assert check_source(
+        inside, [NakedStageTiming()], path="dgraph_tpu/utils/trace.py"
+    ) == []
+    # routing THROUGH obs.stage is clean in the serving tree
+    routed = textwrap.dedent("""
+        from dgraph_tpu import obs
+
+        def expand(self, rows):
+            with obs.stage(self.stats, "device_expand_ms"):
+                return do_expand(rows)
+    """)
+    assert check_source(
+        routed, [NakedStageTiming()], path="dgraph_tpu/query/engine.py"
+    ) == []
+    # outside the serving dirs the rule does not apply (models/, ops/
+    # own their micro-bench timing)
+    bench = textwrap.dedent("""
+        import time
+
+        def measure():
+            t0 = time.perf_counter()
+            work()
+            return time.perf_counter() - t0
+    """)
+    assert check_source(
+        bench, [NakedStageTiming()], path="dgraph_tpu/models/arena.py"
+    ) == []
+    # monotonic() deadline logic is wallclock-rule territory, not this
+    deadline = textwrap.dedent("""
+        import time
+
+        def wait(timeout):
+            deadline = time.monotonic() + timeout
+            return deadline - time.monotonic()
+    """)
+    assert check_source(
+        deadline, [NakedStageTiming()], path="dgraph_tpu/sched/scheduler.py"
+    ) == []
+
+
+def test_naked_stage_timing_pragma_with_why():
+    src = textwrap.dedent("""
+        import time
+
+        def profile(self):
+            t0 = time.perf_counter()
+            run()
+            # offline profiling harness, never in the serving path
+            # graftlint: ignore[naked-stage-timing]
+            return time.perf_counter() - t0
+    """)
+    assert check_source(
+        src, [NakedStageTiming()], path="dgraph_tpu/query/profiler.py"
     ) == []
 
 
