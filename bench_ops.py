@@ -163,6 +163,239 @@ def bench_batched_vs_per_op(platform, emit):
             })
 
 
+def bench_kway_intersection(platform, emit):
+    """MXU join tier, k-way grid: for B ∈ {1, 64, 1024} and
+    k ∈ {2, 4, 8}, ONE intersect_stack_batch program versus the per-op
+    pairwise fold (k-1 intersect_batch dispatches).  Checksum parity
+    against the set-op reference is ASSERTED in the bench; the dispatch
+    count per k-way intersection drops to O(1)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dgraph_tpu import ops
+
+    # dense-ish sets (filter predicates over a shared hot neighborhood):
+    # the k≥4 rows are where the single-program tier wins; k=2 is the
+    # honesty row — "pairwise" IS one op there, so the fused kernel has
+    # nothing to fuse and the ratio hovers around 1.
+    rng = np.random.default_rng(13)
+    n = int(os.environ.get("BO_KWAY_UNIVERSE", 1200))
+    L = int(os.environ.get("BO_KWAY_L", 1024))
+
+    # satellite guard: the k-way folds no longer serialize — neither
+    # lowers to a lax.scan (intersect_many is now a log-depth tree)
+    probe = jnp.asarray(
+        np.stack([ops.pad_to(np.arange(5), 16) for _ in range(8)])
+    )
+    assert "scan[" not in str(jax.make_jaxpr(ops.intersect_many)(probe))
+    assert "scan[" not in str(jax.make_jaxpr(ops.union_many)(probe))
+
+    for B in (1, 64, 1024):
+        for k in (2, 4, 8):
+            sets = [
+                [
+                    np.unique(rng.integers(1, n, size=L - L // 4))
+                    for _ in range(k)
+                ]
+                for _ in range(B)
+            ]
+            mat = np.stack(
+                [
+                    np.stack([ops.pad_to(s, L) for s in row])
+                    for row in sets
+                ]
+            )
+            dmat = jnp.asarray(mat)
+            rows2d = [jnp.asarray(mat[:, i]) for i in range(k)]
+
+            with DispatchCounter() as cf:
+                r = cf.call(ops.intersect_stack_batch, dmat)
+                jax.block_until_ready(r)
+                compiles = cf.compiles
+                fused_s = float("inf")
+                for _ in range(3):
+                    t0 = time.time()
+                    r = cf.call(ops.intersect_stack_batch, dmat)
+                    jax.block_until_ready(r)
+                    fused_s = min(fused_s, time.time() - t0)
+            got = np.asarray(r)
+
+            def per_op(counter):
+                u = rows2d[0]
+                for i in range(1, k):
+                    u = counter.call(ops.intersect_batch, u, rows2d[i])
+                return u
+
+            with DispatchCounter() as cp:
+                ref_out = per_op(cp)
+                jax.block_until_ready(ref_out)
+                n0 = cp.dispatches
+                per_op_s = float("inf")
+                for _ in range(3):
+                    t0 = time.time()
+                    ref_out = per_op(cp)
+                    jax.block_until_ready(ref_out)
+                    per_op_s = min(per_op_s, time.time() - t0)
+                per_dispatches = (cp.dispatches - n0) // 3
+            ref_np = np.asarray(ref_out)
+
+            # checksum parity vs the set-op reference, asserted here
+            SENT = ops.SENT
+            chk_f = np.where(got == SENT, 0, got).sum(dtype=np.int64)
+            chk_p = np.where(ref_np == SENT, 0, ref_np).sum(dtype=np.int64)
+            assert chk_f == chk_p, (chk_f, chk_p)
+            for b in range(B):
+                np.testing.assert_array_equal(
+                    got[b][got[b] != SENT], ref_np[b][ref_np[b] != SENT]
+                )
+            assert per_dispatches == k - 1
+
+            emit("kway_intersect_spgemm_vs_per_op", per_op_s / fused_s,
+                 "x speedup", {
+                     "B": B, "k": k,
+                     "spgemm_dispatches": 1,
+                     "per_op_dispatches": per_dispatches,
+                     "spgemm_compiles": compiles,
+                     "checksum": int(chk_f),
+                     "parity": "ok",
+                     "spgemm_s": round(fused_s, 4),
+                     "per_op_s": round(per_op_s, 4),
+                 })
+
+
+def bench_triangle(platform, emit):
+    """MXU join tier, fused triangle kernel: two legs + closing-predicate
+    tiles in ONE program vs the per-op gather pipeline (expand, dedup,
+    expand, dedup, reverse expand, dedup, intersect = 7 dispatches),
+    over B ∈ {1, 64, 1024} root sets.  Set parity asserted per row."""
+    import jax
+    import jax.numpy as jnp
+
+    from dgraph_tpu import ops
+    from dgraph_tpu.ops import spgemm
+    from dgraph_tpu.query.chain import _topm_deg_sum
+    from bench import build_graph
+
+    # DENSE community-shaped subgraph — the worst-case-optimal join's
+    # design point (EmptyHeaded's triangle wins are on dense cyclic
+    # neighborhoods): every materialized tile lane is useful, while the
+    # gather pipeline pays sort width proportional to the fan-out
+    # explosion.  Sparse shapes route pairwise via the joinplan cost
+    # model — that asymmetry is WHY the route choice exists.
+    n_nodes = int(os.environ.get("BO_TRI_NODES", 512))
+    n_edges = int(os.environ.get("BO_TRI_EDGES", 32768))
+    R = int(os.environ.get("BO_TRI_ROOTS", 48))
+    a = build_graph(n_nodes, n_edges, seed=5)
+    rev = build_graph(n_nodes, n_edges, seed=6)  # closing pred (reverse)
+    t = spgemm.tile_size()
+    pt = spgemm.build_tiles(a.h_src, a.h_offsets, a.host_dst(), t=t)
+    pr = spgemm.build_tiles(rev.h_src, rev.h_offsets, rev.host_dst(), t=t)
+    assert pt is not None and pr is not None
+    uni = max(pt.universe, pr.universe)
+    m = spgemm.mask_lanes(uni, t)
+    rng = np.random.default_rng(17)
+    SENT = ops.SENT
+
+    for B in (1, 64, 1024):
+        roots = [
+            np.unique(rng.integers(1, n_nodes, size=R)) for _ in range(B)
+        ]
+        Lr = ops.bucket(max(len(r) for r in roots))
+        rmat = np.stack([ops.pad_to(r, Lr) for r in roots])
+        drmat = jnp.asarray(rmat)
+        # masks for the fused path (built once per query in the engine)
+        xm = np.zeros((B, m), dtype=np.float32)
+        for i, r in enumerate(roots):
+            xm[i, r] = 1.0
+        dxm = jnp.asarray(xm)
+
+        cap1 = ops.bucket(
+            max(int(a.degree_of_rows(r).sum()) for r in roots)
+        )
+        capw = ops.bucket(
+            max(int(rev.degree_of_rows(r).sum()) for r in roots)
+        )
+        cap2 = ops.bucket(_topm_deg_sum(a, min(cap1, a.n_distinct_dst())))
+
+        # dense arenas: uid == row, but SENT pads must become the -1
+        # skip marker (frontier_rows) before entering the slot map
+        ex1 = jax.jit(jax.vmap(
+            lambda r: ops.expand_ascending(
+                a.offsets, a.dst, ops.frontier_rows(r), cap1
+            )[0]
+        ))
+        ex2 = jax.jit(jax.vmap(
+            lambda r: ops.expand_ascending(
+                a.offsets, a.dst, ops.frontier_rows(r), cap2
+            )[0]
+        ))
+        exw = jax.jit(jax.vmap(
+            lambda r: ops.expand_ascending(
+                rev.offsets, rev.dst, ops.frontier_rows(r), capw
+            )[0]
+        ))
+        dedup = ops.sort_unique_batch
+
+        def per_op(counter):
+            l1 = counter.call(dedup, counter.call(ex1, drmat))
+            l2 = counter.call(dedup, counter.call(ex2, l1))
+            w = counter.call(dedup, counter.call(exw, drmat))
+            return counter.call(ops.intersect_batch, l2, w)
+
+        with DispatchCounter() as cp:
+            ref_out = per_op(cp)
+            jax.block_until_ready(ref_out)
+            n0 = cp.dispatches
+            per_op_s = float("inf")
+            for _ in range(3):
+                t0 = time.time()
+                ref_out = per_op(cp)
+                jax.block_until_ready(ref_out)
+                per_op_s = min(per_op_s, time.time() - t0)
+            per_dispatches = (cp.dispatches - n0) // 3
+        ref_np = np.asarray(ref_out)
+
+        with DispatchCounter() as cf:
+            z = cf.call(
+                spgemm.triangle_mask_batch,
+                pt.bi, pt.bj, pt.tiles, pt.bi, pt.bj, pt.tiles,
+                pr.bi, pr.bj, pr.tiles, dxm,
+            )
+            jax.block_until_ready(z)
+            compiles = cf.compiles
+            fused_s = float("inf")
+            for _ in range(3):
+                t0 = time.time()
+                z = cf.call(
+                    spgemm.triangle_mask_batch,
+                    pt.bi, pt.bj, pt.tiles, pt.bi, pt.bj, pt.tiles,
+                    pr.bi, pr.bj, pr.tiles, dxm,
+                )
+                jax.block_until_ready(z)
+                fused_s = min(fused_s, time.time() - t0)
+        zm = np.asarray(z)
+
+        # parity: fused closing masks == the set-op reference pipeline
+        chk = 0
+        for b in range(B):
+            want = ref_np[b][ref_np[b] != SENT].astype(np.int64)
+            got = np.flatnonzero(zm[b] > 0).astype(np.int64)
+            np.testing.assert_array_equal(got, np.unique(want))
+            chk += int(got.sum())
+
+        emit("triangle_spgemm_vs_per_op", per_op_s / fused_s, "x speedup", {
+            "B": B, "roots": R,
+            "spgemm_dispatches": 1,
+            "per_op_dispatches": per_dispatches,
+            "spgemm_compiles": compiles,
+            "tiles": int(pt.n_tiles + pr.n_tiles),
+            "checksum": chk,
+            "parity": "ok",
+            "spgemm_s": round(fused_s, 4),
+            "per_op_s": round(per_op_s, 4),
+        })
+
+
 def main():
     from bench import ensure_backend
 
@@ -184,6 +417,8 @@ def main():
         print(json.dumps(rec), flush=True)
 
     bench_batched_vs_per_op(platform, emit)
+    bench_kway_intersection(platform, emit)
+    bench_triangle(platform, emit)
 
     n_nodes = int(os.environ.get("BO_NODES", 500_000))
     n_edges = int(os.environ.get("BO_EDGES", 4_000_000))

@@ -175,6 +175,10 @@ class CSRArena:
         for pair in (self._chunked, self._inline, self._inline_grouped):
             if pair is not None:
                 n += sum(t.size * t.dtype.itemsize for t in pair)
+        if self._tiles is not None:
+            # MXU join tier (ops/spgemm.py): densified adjacency blocks
+            # ride the same HBM budget/eviction as every other layout
+            n += self._tiles.device_bytes()
         return n
 
     _inline: Optional[tuple] = None  # lazy (metap, ov_chunks)
@@ -274,6 +278,81 @@ class CSRArena:
             self._inline_grouped = (jnp.asarray(metap), jnp.asarray(ov))
             return self._inline_grouped
 
+    # -- MXU join tier (ops/spgemm.py) --------------------------------------
+
+    _tiles: Optional[object] = None  # lazy PredTiles (blocked adjacency)
+
+    def tile_blocks(self) -> Tuple[int, int]:
+        """(non-empty adjacency block count, universe) at the current
+        tile size — the join planner's byte estimate, computable WITHOUT
+        building the tiles (one O(E) unique pass, cached; invalidated
+        with the other derived layouts on apply_delta)."""
+        from dgraph_tpu.ops import spgemm
+
+        t = spgemm.tile_size()
+        cached = getattr(self, "_tile_blocks", None)
+        if cached is not None and cached[0] == t:
+            return cached[1], cached[2]
+        if self.n_edges == 0:
+            k, uni = 0, 0
+        else:
+            k, uni = spgemm.count_tile_blocks(
+                self.h_src, self.h_offsets, self.host_dst(), t
+            )
+        self._tile_blocks = (t, k, uni)
+        return k, uni
+
+    def tiles(self):
+        """Blocked boolean adjacency tiles for the MXU join tier, built
+        lazily from the CSR host mirrors and cached on the arena (they
+        die with it, like every derived layout; device_bytes() accounts
+        them, so the ArenaManager HBM budget governs their residency).
+        Returns None — without caching a negative — when the estimated
+        footprint exceeds DGRAPH_TPU_TILE_BUDGET or the arena is
+        edgeless; the planner then stays on the gather tier."""
+        from dgraph_tpu.ops import spgemm
+        from dgraph_tpu.utils.metrics import JOIN_TILE_BUILDS, JOIN_TILE_BYTES
+
+        pt = self._tiles
+        t = spgemm.tile_size()
+        if pt is not None and pt.t == t:
+            return pt
+        if self.n_edges == 0:
+            return None
+        k, _uni = self.tile_blocks()
+        if spgemm.est_tile_bytes(k, t) > spgemm.tile_budget():
+            return None
+        with _BUILD_LOCK:
+            pt = self._tiles
+            if pt is not None and pt.t == t:
+                return pt
+            pt = spgemm.build_tiles(
+                self.h_src, self.h_offsets, self.host_dst(), t=t
+            )
+            if pt is not None:
+                self._tiles = pt
+                JOIN_TILE_BUILDS.add()
+                JOIN_TILE_BYTES.add(pt.device_bytes())
+            return pt
+
+    def degree_histogram(self) -> np.ndarray:
+        """Log2-bucketed out-degree histogram: slot c counts rows with
+        ⌈log2(degree)⌉ == c (degree ≥ 1; slot 0 holds degree-1 rows).
+        Cached; the join planner reads it to spot heavy-tailed
+        predicates, where the dense-tile pass is immune to the skew
+        that serializes gather capacity planning."""
+        h = getattr(self, "_deg_hist", None)
+        if h is None:
+            deg = (self.h_offsets[1:] - self.h_offsets[:-1]).astype(np.int64)
+            deg = deg[deg > 0]
+            if len(deg):
+                c = np.ceil(np.log2(deg, where=deg > 1, out=np.zeros(len(deg))))
+                h = np.bincount(c.astype(np.int64), minlength=32)
+            else:
+                h = np.zeros(32, dtype=np.int64)
+            self._deg_hist = h
+        return h
+
     _lut: Optional[jnp.ndarray] = None
 
     def lut(self, universe: int) -> jnp.ndarray:
@@ -372,8 +451,12 @@ class CSRArena:
         self._inline = None
         self._inline_grouped = None
         self._lut = None
+        self._tiles = None
         self._n_distinct_dst = None
-        for attr in ("_topm_cdeg", "_topm_ovdeg", "_topm_deg", "_classed"):
+        for attr in (
+            "_topm_cdeg", "_topm_ovdeg", "_topm_deg", "_classed",
+            "_tile_blocks", "_deg_hist",
+        ):
             if hasattr(self, attr):
                 delattr(self, attr)
         self._device_stale = True

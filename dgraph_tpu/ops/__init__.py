@@ -59,4 +59,24 @@ from dgraph_tpu.ops.batch import (  # noqa: F401
     sort_unique_batch,
     union_many_batch,
 )
+from dgraph_tpu.ops.spgemm import (  # noqa: F401
+    PredTiles,
+    build_tiles,
+    count_tile_blocks,
+    est_tile_bytes,
+    expand_counts,
+    expand_mask,
+    expand_mask_batch,
+    intersect_masks,
+    intersect_stack,
+    intersect_stack_batch,
+    mask_lanes,
+    mask_to_uids,
+    run_mask_chain,
+    tile_budget,
+    tile_size,
+    triangle_mask,
+    triangle_mask_batch,
+    uids_to_mask,
+)
 from dgraph_tpu.ops import ref  # noqa: F401

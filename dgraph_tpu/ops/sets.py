@@ -140,23 +140,50 @@ def union(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return sort_unique(jnp.concatenate([a, b]))
 
 
+def _intersect_pair_sorted(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a ∩ b via duplicate detection over the sorted concatenation: both
+    inputs are sorted-UNIQUE, so an element of the merged sort equal to
+    its successor appears in both sets.  Two bitonic sorts, zero
+    searchsorted — jnp.searchsorted lowers to a lax.scan (even its
+    'unrolled' method keeps the scan primitive), and the k-way tree
+    reduction below must be PROVABLY scan-free (bench_ops.py asserts
+    it on the jaxpr).  Result shaped like ``a`` (|a ∩ b| ≤ |a|)."""
+    z = sort_desc_free(jnp.concatenate([a, b]))
+    dup = (z[:-1] == z[1:]) & (z[:-1] != SENT)
+    dup = jnp.concatenate([dup, jnp.zeros((1,), bool)])
+    return sort_desc_free(jnp.where(dup, z, SENT))[: a.shape[0]]
+
+
 @jax.jit
 def intersect_many(mat: jnp.ndarray) -> jnp.ndarray:
     """Intersect the K rows of a [K, L] padded matrix (algo.IntersectSorted,
-    algo/uidlist.go:183-215).  The reference sorts lists smallest-first; on
-    TPU every fold step costs the same, so we just scan.
-    """
-    def body(acc, row):
-        return intersect(acc, row), None
-
-    acc, _ = jax.lax.scan(body, mat[0], mat[1:])
-    return acc
+    algo/uidlist.go:183-215) as a LOG-DEPTH TREE REDUCTION: rows pair
+    off and intersect vmapped per round, halving K each time — ⌈log2 K⌉
+    data-parallel rounds instead of the K-1-step serial ``lax.scan``
+    fold this kernel used to lower to (every scan step waited on the
+    previous accumulator; the tree's rounds each run all their pairwise
+    intersections in parallel lanes).  Odd widths pad by duplicating
+    the last row — intersection is idempotent, so the duplicate is a
+    no-op.  bench_ops.py asserts the lowered program contains no
+    ``scan`` primitive."""
+    k = mat.shape[0]
+    while k > 1:
+        if k % 2:
+            mat = jnp.concatenate([mat, mat[-1:]])
+            k += 1
+        mat = jax.vmap(_intersect_pair_sorted)(mat[0::2], mat[1::2])
+        k //= 2
+    return mat[0]
 
 
 @jax.jit
 def union_many(mat: jnp.ndarray) -> jnp.ndarray:
     """Union of the K rows of a [K, L] padded matrix (k-way MergeSorted,
-    algo/uidlist.go:249 — the min-heap becomes one flat sort)."""
+    algo/uidlist.go:249 — the min-heap becomes one flat sort).  Already
+    scan-free: a single bitonic sort over the flattened matrix is
+    log²-depth, strictly shallower than a tree of per-round merge
+    sorts, so no reduction tree is needed here (bench_ops.py asserts
+    the no-scan property for both k-way folds)."""
     return sort_unique(mat.reshape(-1))
 
 

@@ -301,3 +301,75 @@ def sharded_two_hop(mesh: Mesh, arena: ShardedArena, frontier: np.ndarray, cap1:
     h1 = step1(arena.src, arena.offsets, arena.dst, f)
     h2 = step2(arena.src, arena.offsets, arena.dst, h1)
     return h1, h2
+
+
+# -- MXU join tier: tiles sharded over the model axis -------------------------
+
+
+def shard_tiles(pt, n_shards: int):
+    """Split a PredTiles' stored blocks round-robin across ``n_shards``
+    model-axis shards (host-side).  Pad slots are zero tiles at block
+    (0, 0) — they contribute nothing to the psum combine, so uneven
+    splits need no masking.  Returns (bi [n, Kp], bj [n, Kp],
+    tiles [n, Kp, T, T]) ready for sharded_expand_mask."""
+    bi = np.asarray(pt.bi)[: max(1, pt.n_tiles)]
+    bj = np.asarray(pt.bj)[: max(1, pt.n_tiles)]
+    tiles = np.asarray(pt.tiles)[: max(1, pt.n_tiles)]
+    K = len(bi)
+    per = -(-K // n_shards)
+    Kp = ops.bucket(max(1, per))
+    t = tiles.shape[1]
+    sbi = np.zeros((n_shards, Kp), dtype=np.int32)
+    sbj = np.zeros((n_shards, Kp), dtype=np.int32)
+    stl = np.zeros((n_shards, Kp, t, t), dtype=np.float32)
+    for i in range(n_shards):
+        sl = slice(i * per, min(K, (i + 1) * per))
+        w = sl.stop - sl.start
+        if w <= 0:
+            continue
+        sbi[i, :w] = bi[sl]
+        sbj[i, :w] = bj[sl]
+        stl[i, :w] = tiles[sl]
+    return jnp.asarray(sbi), jnp.asarray(sbj), jnp.asarray(stl)
+
+
+@lru_cache(maxsize=64)
+def tile_expand_step(mesh: Mesh, kp: int, t: int, m: int):
+    """One blocked-boolean-SpMV hop over MODEL-sharded tiles: each
+    device computes its tile slice's contributions (the same
+    einsum + one-hot combine as ops.spgemm._tile_counts — scatter-free)
+    and shards combine via psum.  Memoized per (mesh, shapes) like
+    sharded_expand_step so serving paths reuse compiled programs."""
+
+    def local(bi, bj, tiles, x):
+        bi, bj, tiles = bi[0], bj[0], tiles[0]
+        xb = x.reshape(-1, t)
+        contrib = jnp.einsum("kt,ktu->ku", xb[bi], tiles)
+        oh = jax.nn.one_hot(bj, xb.shape[0], dtype=x.dtype)
+        part = jnp.einsum("kj,kt->jt", oh, contrib).reshape(-1)
+        total = jax.lax.psum(part, "model")
+        return (total > 0).astype(x.dtype)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P("model", None),
+            P("model", None),
+            P("model", None, None),
+            P(),
+        ),
+        out_specs=P(),
+        check_rep=False,
+    )
+    return jax.jit(fn)
+
+
+def sharded_expand_mask(mesh: Mesh, sbi, sbj, stiles, x):
+    """Frontier-mask expansion with the tile set sharded on the 'model'
+    axis: returns the next-frontier mask (replicated), identical in
+    content to ops.expand_mask over the unsharded tiles."""
+    step = tile_expand_step(
+        mesh, int(sbi.shape[1]), int(stiles.shape[2]), int(x.shape[0])
+    )
+    return step(sbi, sbj, stiles, x)

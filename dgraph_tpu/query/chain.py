@@ -329,6 +329,17 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
         # frontier; an order-by at the root permutes dest_uids, so fusing
         # would corrupt the matrices — fall back
         return reject("frontier not ascending-distinct")
+    # MXU join tier (query/joinplan.py): light chains — including the
+    # cyclic triangle shape (two legs + a globally-resolvable closing
+    # @filter the gather chain below can't fuse) — may run as ONE
+    # blocked-boolean-matmul program when the per-query cost model picks
+    # generic join over pairwise expansion.  Declines fall through to
+    # the gather paths below; every decision lands in
+    # engine.stats["join_routes"].
+    from dgraph_tpu.query.joinplan import try_mxu_route
+
+    if try_mxu_route(engine, child, src, resolver):
+        return True
     levels = collect_chain(engine, child)
     if len(levels) < 2:
         return reject("chain shorter than 2 levels")
@@ -546,11 +557,18 @@ def _resolve_filter_global(engine, ft, resolver) -> np.ndarray:
     if ft.func is not None:
         return np.asarray(resolver.resolve(ft.func, None), dtype=np.int64)
     if ft.op == "and":
-        out = None
-        for c in ft.children:
-            s = _resolve_filter_global(engine, c, resolver)
-            out = s if out is None else np.intersect1d(out, s)
-        return out if out is not None else np.empty(0, np.int64)
+        # k-way fold routed host-or-device by size (query/joinplan.py):
+        # candidates that came off-device no longer force k-1 host
+        # np.intersect1d passes — above the gate ONE batched device
+        # program intersects the whole stack
+        from dgraph_tpu.query.joinplan import kway_intersect
+
+        parts = [
+            _resolve_filter_global(engine, c, resolver) for c in ft.children
+        ]
+        if not parts:
+            return np.empty(0, np.int64)
+        return kway_intersect(parts, stats=engine.stats)
     if ft.op == "or":
         parts = [_resolve_filter_global(engine, c, resolver) for c in ft.children]
         out = parts[0]

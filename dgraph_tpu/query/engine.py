@@ -88,10 +88,19 @@ def _fresh_stats() -> dict:
         # never attempted) — the eligibility logic must be debuggable at
         # benchmark scale, not a silent no (VERDICT r4 weak #2)
         "chain_reject": [],
+        # MXU join tier (query/joinplan.py): one entry per route decision
+        # (mxu generic-join vs pairwise expansion, with the cost
+        # estimates that drove it — the chain_reject discipline), plus
+        # host-vs-device counts for size-gated k-way intersections
+        "join_routes": [],
+        "kway_device": 0,
+        "kway_host": 0,
         "host_expand_ms": 0.0,
         "device_expand_ms": 0.0,
         "chain_ms": 0.0,
         "device_order_ms": 0.0,
+        "tile_build_ms": 0.0,
+        "mxu_join_ms": 0.0,
     }
 
 
@@ -105,7 +114,12 @@ class DeviceExpander:
     backends where XLA scatter+sort lag its gathers; requires an
     ascending-distinct frontier) → inline-head device path (the TPU
     gather-rate layout) → order-agnostic packed CSR (any frontier
-    order).  The fused path is gated by ``fused_hop``:
+    order).  A sixth route lives ABOVE this per-level entry: the
+    ``mxu`` join tier (query/joinplan.py + ops/spgemm.py) takes whole
+    light chains — cyclic/triangle patterns included — as one blocked
+    boolean-matmul program before the per-level machinery ever runs;
+    its hop spans carry ``route:mxu`` with the tile-build vs matmul
+    time split.  The fused path is gated by ``fused_hop``:
 
       "0"    — never (legacy per-op routing only)
       "1"/"" — auto: on where the default backend is cpu (measured: XLA
@@ -148,7 +162,8 @@ class DeviceExpander:
         """Per-level expansion entry.  When the request is SAMPLED
         (obs/spans.py), each call records one ``hop`` span carrying the
         predicate, frontier size, edges traversed, the route the
-        expansion took (cache/merged/mesh/host/classed/inline/csr) and
+        expansion took (cache/merged/mesh/host/classed/inline/csr; the
+        chain-level ``mxu`` route emits its own hop span upstream) and
         the device-time split; the unsampled path branches away before
         any span object exists."""
         sp = obs.current_span()
@@ -508,7 +523,9 @@ class QueryEngine:
     # -- block execution ---------------------------------------------------
 
     def _exec_block(self, sg: SubGraph, uid_vars, value_vars):
-        resolver = FuncResolver(self.store, self.arenas, uid_vars, value_vars)
+        resolver = FuncResolver(
+            self.store, self.arenas, uid_vars, value_vars, stats=self.stats
+        )
         # var blocks are never encoded → chains under them may skip result
         # matrices entirely (light mode, query/chain.py)
         self._cur_block_internal = bool(sg.params.is_internal)
@@ -834,6 +851,35 @@ class QueryEngine:
         if ft.func is not None:
             return resolver.resolve(ft.func, candidates)
         if ft.op == "and":
+            # multi-predicate intersection (the MXU join tier's k-way
+            # entry, query/joinplan.py): leaves that resolve WITHOUT the
+            # frontier — index funcs, has(), uid sets — intersect with
+            # the candidates as ONE k-way pass (size-routed host/device)
+            # instead of k sequential narrowing passes.  AND children
+            # are set filters, so the intersection commutes: frontier-
+            # dependent leaves (val/count/uid_in/checkpwd) and nested
+            # trees apply sequentially on the k-way result, and the
+            # output is byte-identical to the legacy fold.  Each leaf
+            # already resolved its full set before narrowing (resolve →
+            # _bound), so the reorder adds no resolution work.
+            from dgraph_tpu.query import joinplan
+
+            if joinplan.mxu_mode() != "0":
+                glob = [
+                    c for c in ft.children
+                    if c.func is not None
+                    and joinplan.filter_leaf_global(c.func)
+                ]
+                if len(glob) >= 2:
+                    sets = [resolver.resolve(c.func, None) for c in glob]
+                    out = joinplan.kway_intersect(
+                        [candidates] + sets, stats=self.stats
+                    )
+                    gids = {id(c) for c in glob}
+                    for c in ft.children:
+                        if id(c) not in gids:
+                            out = self._apply_filter(c, out, resolver)
+                    return out
             out = candidates
             for c in ft.children:
                 out = self._apply_filter(c, out, resolver)

@@ -48,11 +48,16 @@ class FuncResolver:
         arenas: ArenaManager,
         uid_vars: Dict[str, np.ndarray],
         value_vars: Dict[str, Dict[int, TypedValue]],
+        stats: Optional[dict] = None,
     ):
         self.store = store
         self.arenas = arenas
         self.uid_vars = uid_vars
         self.value_vars = value_vars
+        # per-request engine stats (QueryEngine passes its own): the
+        # k-way intersection router counts its host-vs-device choices
+        # here so debug=true responses agree with the process counters
+        self.stats = stats
 
     # -- public ------------------------------------------------------------
 
@@ -216,9 +221,12 @@ class FuncResolver:
             if any(r < 0 for r in rows) or not rows:
                 return _EMPTY
             sets = [self._expand_rows(idx.csr, np.array([r])) for r in rows]
-            cand = sets[0]
-            for s in sets[1:]:
-                cand = np.intersect1d(cand, s)
+            # size-routed k-way intersection (query/joinplan.py): the
+            # candidates came off-device — above the gate they stay
+            # there for ONE batched intersect instead of a host fold
+            from dgraph_tpu.query.joinplan import kway_intersect
+
+            cand = kway_intersect(sets, stats=self.stats)
             return self._host_recheck(pred, cand, "eq", val, fn.lang)
         if not tk.sortable and op != "eq":
             raise QueryError(
@@ -285,9 +293,15 @@ class FuncResolver:
                 sets.append(_EMPTY)
             else:
                 sets.append(self._expand_rows(idx.csr, np.array([r])))
+        if all_of:
+            # allofterms = k-way intersection of token posting sets:
+            # size-routed through the join tier (query/joinplan.py)
+            from dgraph_tpu.query.joinplan import kway_intersect
+
+            return kway_intersect(sets, stats=self.stats)
         out = sets[0]
         for s in sets[1:]:
-            out = np.intersect1d(out, s) if all_of else np.union1d(out, s)
+            out = np.union1d(out, s)
         return out
 
     def _regexp(self, fn: Function) -> np.ndarray:
@@ -320,11 +334,21 @@ class FuncResolver:
         )
         if prunable:
             idx = self.arenas.index(fn.attr, "trigram")
+            tsets = []
             for lit in _literal_runs(pat):
                 for tg in tokmod.trigram_tokens(lit):
                     r = idx.row_of(tg)
-                    s = self._expand_rows(idx.csr, np.array([r])) if r >= 0 else _EMPTY
-                    cand = s if cand is None else np.intersect1d(cand, s)
+                    tsets.append(
+                        self._expand_rows(idx.csr, np.array([r]))
+                        if r >= 0
+                        else _EMPTY
+                    )
+            if tsets:
+                # trigram AND: one size-routed k-way pass over every
+                # literal's posting set (query/joinplan.py)
+                from dgraph_tpu.query.joinplan import kway_intersect
+
+                cand = kway_intersect(tsets, stats=self.stats)
         if cand is None:
             pd = self.store.peek(fn.attr)
             cand = (

@@ -516,9 +516,16 @@ def _make_handler(srv: DgraphServer):
 
                 self._reply(200, DASHBOARD_HTML.encode(), "text/html")
             elif path == "/debug/store":
+                from dgraph_tpu.query import joinplan
+
                 with srv._engine_lock.read():
                     stats = _store_stats(srv.store)
                 stats["qcache"] = _qcache_stats(srv)
+                # MXU join tier: route counts + the recent decision ring
+                # (mxu vs pairwise with the cost estimates that drove
+                # each choice) — the chain_reject explainability,
+                # process-wide
+                stats["join"] = joinplan.debug_summary()
                 self._reply(200, json.dumps(stats).encode())
             elif path in ("/metrics", "/debug/prometheus_metrics"):
                 # /metrics is the standard scrape alias; the debug path

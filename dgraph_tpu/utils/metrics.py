@@ -478,6 +478,23 @@ TRACES_RECORDED = metrics.counter("dgraph_traces_recorded_total")
 SLOW_QUERIES = metrics.counter("dgraph_slow_queries_total")
 
 
+# MXU join tier (ops/spgemm.py + query/joinplan.py): every per-query
+# route decision (mxu generic-join vs pairwise expansion) and every
+# size-gated k-way intersection's host-vs-device choice is counted, so
+# a bench run — or an operator staring at /debug/store — can explain
+# exactly which tier served which shape (the chain_reject discipline,
+# applied to join routing).
+JOIN_ROUTES = metrics.labeled("dgraph_join_route_total", label="route")
+KWAY_INTERSECTS = metrics.labeled(
+    "dgraph_kway_intersect_total", label="route"
+)
+JOIN_TILE_BUILDS = metrics.counter("dgraph_join_tile_builds_total")
+# cumulative bytes densified (a counter, not an occupancy gauge: tiles
+# die with their arena under the HBM budget, and live occupancy is
+# already visible through the arena-bytes accounting)
+JOIN_TILE_BYTES = metrics.counter("dgraph_join_tile_built_bytes_total")
+
+
 def note_swallowed(site: str, exc: BaseException) -> None:
     """Count an intentionally-dropped exception at ``site`` (a short
     dotted location like ``transport.grpc_send``).  The exception TYPE
