@@ -829,6 +829,23 @@ def run_mutation_bench():
 def run_bench(scale: float):
     import jax
 
+    # measured-cost planner: run (or load) the micro-calibration pass up
+    # front so every route decision in this run prices from THIS host's
+    # rates, and the calibration file is fresh for the next server boot
+    from dgraph_tpu.query import planner
+
+    if planner.enabled():
+        try:
+            planner.boot(measure_now=True)
+        except Exception as e:
+            print(
+                f"# calibration skipped ({type(e).__name__}: {e})",
+                file=sys.stderr,
+            )
+    # a DGRAPH_TPU_PLANNER=0 arm must not mutate planner state: no
+    # measurement pass, no calibration-file overwrite — the operator
+    # disabled the planner, the bench honors it
+
     n_nodes = max(1024, int(int(os.environ.get("BENCH_NODES", 2_000_000)) * scale))
     n_edges = max(4096, int(int(os.environ.get("BENCH_EDGES", 21_000_000)) * scale))
     n_seeds = max(64, int(int(os.environ.get("BENCH_SEEDS", 4096)) * min(1.0, scale * 4)))
@@ -905,6 +922,21 @@ def run_bench(scale: float):
             durability = run_mutation_bench()
         except Exception as e:
             durability = {"error": f"{type(e).__name__}: {e}"}
+    # planner honesty row: every route decision this process made (the
+    # serving arms run in-process) with the measured mispredict rate —
+    # future bench rounds show route choice alongside throughput, and a
+    # rising mispredict rate means the calibration no longer fits
+    cal = planner.calibration_info()
+    planner_summary = {
+        **planner.mispredict_stats(),
+        "decisions_by_route": planner.debug_summary()["counts"],
+        "calibration_source": cal["source"],
+        "calibrated_dispatch_us": round(cal["rates"]["dispatch_us"], 2),
+        "calibrated_device_edge_us": round(
+            cal["rates"]["device_edge_us"], 5
+        ),
+        "calibrated_host_edge_us": round(cal["rates"]["host_edge_us"], 5),
+    }
     print(
         json.dumps(
             {
@@ -918,6 +950,10 @@ def run_bench(scale: float):
                 # durable-mutation A/B (BENCH_MUT=0 skips;
                 # BENCH_MUT_CLIENTS / BENCH_MUT_SECONDS size it)
                 "durability": durability,
+                # measured-cost planner (PR 10): per-route decision
+                # counts + mispredict rate + the calibrated rates that
+                # drove this run's routing
+                "planner": planner_summary,
                 # self-describing record: a wedged-TPU round falls back to
                 # XLA-on-CPU (see ensure_backend) and must not read as a
                 # TPU measurement

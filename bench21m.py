@@ -184,6 +184,11 @@ def main():
         "edges": eng.stats["edges"],
         "fused_levels": eng.stats["chain_fused_levels"],
         "chain_reject": eng.stats["chain_reject"],
+        # PR 10: the calibrated route decisions (with both cost
+        # estimates) that admitted/declined this shape — the fix for the
+        # r5 regression where `chain_reject: "fan-out estimate 168342
+        # below threshold 262144"` kept this query off the chain scan
+        "planner": eng.stats.get("planner", []),
         # traversal rate NET of fixed dispatch overhead; None when the
         # query is too small for the subtraction to mean anything
         "edges_per_sec": round(eng.stats["edges"] / min(times), 1),
@@ -226,6 +231,7 @@ def main():
     edges = eng.stats["edges"]
     fused = eng.stats["chain_fused_levels"]
     chain_reject = eng.stats["chain_reject"]
+    planner_decs = eng.stats.get("planner", [])
     # the SAME shape with the device paths disabled (chains off, per-level
     # host numpy): the measured device-vs-host comparison the round-3
     # bench only asserted
@@ -249,6 +255,7 @@ def main():
         "edges": edges,
         "fused_levels": fused,
         "chain_reject": chain_reject,
+        "planner": planner_decs,
         "ms": round(chain_s * 1e3, 1),
         "host_ms": round(host_s * 1e3, 1),
         "device_vs_host": round(host_s / chain_s, 2),

@@ -41,6 +41,13 @@ on a noisy 2-core CPU host:
   attributable to a trace instead of vanishing into a local variable;
   ``obs/`` and ``utils/trace.py`` are the sanctioned homes of the raw
   clock reads.
+- ``naked-route-threshold``: a raw big-number comparison or a
+  ``DGRAPH_TPU_*`` env read in ``query/`` or ``ops/`` — route-gate
+  thresholds grew as scattered magic numbers until two independent
+  ``262144`` twins (chain.py / joinplan.py) kept the chain scan out of
+  3-hop queries it wins (BENCH21M).  Every gate lives in
+  ``utils/planconfig.py`` with a documented default, and the decision
+  itself belongs to the calibrated planner (``query/planner.py``).
 
 Suppress a deliberate site with ``# graftlint: ignore[rule-id]`` on the
 line (or the line above).  docs/analysis.md has the full catalog and
@@ -693,6 +700,95 @@ class NakedStageTiming(Rule):
         return names
 
 
+# -- rule: naked-route-threshold --------------------------------------------
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    """Fold an integer-literal expression: plain Constant, unary minus,
+    and BinOps of constants (``1 << 21``, ``4 * 1024``) — the spellings
+    magic thresholds actually use."""
+    if isinstance(node, ast.Constant) and type(node.value) is int:
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand)
+        return -v if v is not None else None
+    if isinstance(node, ast.BinOp):
+        l, r = _const_int(node.left), _const_int(node.right)
+        if l is None or r is None:
+            return None
+        try:
+            if isinstance(node.op, ast.LShift):
+                return l << r
+            if isinstance(node.op, ast.Mult):
+                return l * r
+            if isinstance(node.op, ast.Add):
+                return l + r
+            if isinstance(node.op, ast.Sub):
+                return l - r
+            if isinstance(node.op, ast.Pow):
+                return l**r if 0 <= r <= 64 else None
+        except (OverflowError, ValueError):
+            return None
+    return None
+
+
+class NakedRouteThreshold(Rule):
+    id = "naked-route-threshold"
+    doc = (
+        "raw numeric route-gate comparison or DGRAPH_TPU_* env read in "
+        "query//ops/ — thresholds live in utils/planconfig.py (documented "
+        "defaults, override detection) and decisions in query/planner.py "
+        "(calibrated cost model)"
+    )
+
+    # query/ and ops/ are the layers where route gates live; the config
+    # module itself sits in utils/ — outside the scanned dirs by design,
+    # so it needs no exemption.  The literal floor (65536) is far above
+    # any capacity/bucket constant but below every historical gate
+    # (262144, 1<<21, 1<<22); disabling-style sentinels (1 << 60) are
+    # exactly the pattern that belongs behind a planconfig name too.
+    _DIRS = ("query/", "ops/")
+    _FLOOR = 65536
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if not any(d in path for d in self._DIRS):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                knob = None
+                if d in ("os.environ.get", "os.getenv") and node.args:
+                    a0 = node.args[0]
+                    if isinstance(a0, ast.Constant) and isinstance(
+                        a0.value, str
+                    ):
+                        knob = a0.value
+                if knob is not None and knob.startswith("DGRAPH_TPU_"):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"env read of {knob} in the routing layers: knob "
+                        "reads belong in utils/planconfig.py (one table "
+                        "of documented defaults the planner can treat as "
+                        "overridable) — two independently-grown 262144 "
+                        "twins is how we got here",
+                    )
+            elif isinstance(node, ast.Compare):
+                operands = [node.left] + list(node.comparators)
+                for op in operands:
+                    v = _const_int(op)
+                    if v is not None and abs(v) >= self._FLOOR:
+                        yield ctx.finding(
+                            self.id, node,
+                            f"naked numeric gate ({v}) in a comparison: "
+                            "name it in utils/planconfig.py (or derive it "
+                            "from the calibrated model in "
+                            "query/planner.py) so the threshold is "
+                            "documented, overridable and auditable — or "
+                            "pragma the site with the WHY",
+                        )
+                        break
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     HostSyncInJit(),
     RecompileHazard(),
@@ -701,4 +797,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     NakedPeerRpc(),
     NakedAtomicWrite(),
     NakedStageTiming(),
+    NakedRouteThreshold(),
 )

@@ -680,12 +680,13 @@ class ArenaManager:
         self.shard_threshold = shard_threshold
         # single source of truth for host-vs-device expansion routing
         # (engine and FuncResolver both read it; engine may retune at
-        # runtime) — see QueryEngine.__init__ for the rationale
-        import os as _os
+        # runtime) — see QueryEngine.__init__ for the rationale.  While
+        # it sits at the planconfig default, the adaptive planner
+        # (query/planner.py) substitutes its calibrated break-even;
+        # assigning it (or pinning the env knob) restores the static gate
+        from dgraph_tpu.utils import planconfig as _planconfig
 
-        self.expand_device_min = int(
-            _os.environ.get("DGRAPH_TPU_EXPAND_DEVICE_MIN", 262144)
-        )
+        self.expand_device_min = _planconfig.expand_device_min()
         self._data: Dict[str, CSRArena] = {}
         self._reverse: Dict[str, CSRArena] = {}
         self._index: Dict[Tuple[str, str], IndexArena] = {}
@@ -703,6 +704,8 @@ class ArenaManager:
         # WHOLLY from the cache (host store keeps the truth; the next
         # access rebuilds), touched arenas move to the LRU tail.
         from collections import OrderedDict as _OD
+
+        import os as _os
 
         self.budget_bytes = int(
             budget_bytes

@@ -61,10 +61,17 @@ from dgraph_tpu.ops.sets import (
     sort_unique,
 )
 
-# widest per-row gather class: rows with degree above 2^LOG_W_MAX go to
-# the dense residual bucket (a handful of celebrity rows must not force
-# a megalane class matrix on everyone)
-LOG_W_MAX = 10
+# widest per-row gather class: rows with degree above 2^LOG_W_MAX route
+# to the dense residual bucket (a handful of celebrity rows must not
+# force a megalane class matrix on everyone).  The class/residual split
+# is a route-selection knob like the rest — its read lives in
+# utils/planconfig.py (DGRAPH_TPU_CLASS_W_MAX) with the other gates —
+# but it is bound ONCE at import: the split shapes every compiled hop
+# program, so a per-call read would churn the jit cache (documented in
+# planconfig's module contract; set the env before first import).
+from dgraph_tpu.utils.planconfig import class_w_max
+
+LOG_W_MAX = class_w_max()
 
 
 # -- batched set ops ---------------------------------------------------------

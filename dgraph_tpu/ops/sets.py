@@ -34,13 +34,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-import os
+from dgraph_tpu.utils.planconfig import expand_impl
 
 # Padding sentinel: int32 max. Sorts after every valid uid.
 SENT = (1 << 31) - 1
 
 # expand_csr owner-computation strategy; see comment in expand_csr.
-_EXPAND_IMPL = os.environ.get("DGRAPH_TPU_EXPAND_IMPL", "scan")
+# (Knob read lives in utils/planconfig.py with the other route/kernel
+# selection knobs — graftlint: naked-route-threshold.)
+_EXPAND_IMPL = expand_impl()
 
 
 def bucket(n: int, floor: int = 8) -> int:

@@ -53,7 +53,6 @@ query/joinplan.py; docs/deploy.md ("Join tier") covers the knobs.
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional, Tuple
@@ -63,20 +62,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from dgraph_tpu.ops.sets import SENT, bucket, member_mask, sort_desc_free
+from dgraph_tpu.utils import planconfig
 
 
 def tile_size() -> int:
     """Tile edge length (uids per block side).  128 is MXU-native; tests
     may shrink it via DGRAPH_TPU_TILE to exercise multi-block layouts on
-    small fixtures."""
-    return int(os.environ.get("DGRAPH_TPU_TILE", 128))
+    small fixtures.  (Knob read: utils/planconfig.py.)"""
+    return planconfig.tile_size()
 
 
 def tile_budget() -> int:
     """Per-arena tile byte budget (DGRAPH_TPU_TILE_BUDGET, default
     256MB).  Arenas whose non-empty-block count would exceed it refuse
     to densify and the join planner falls back to pairwise expansion."""
-    return int(os.environ.get("DGRAPH_TPU_TILE_BUDGET", 1 << 28))
+    return planconfig.tile_budget()
 
 
 def mask_lanes(universe: int, t: Optional[int] = None) -> int:

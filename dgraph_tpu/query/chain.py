@@ -35,7 +35,6 @@ compile (memory guard), never mid-query.
 
 from __future__ import annotations
 
-import os
 from functools import partial
 from typing import List, Optional, Tuple
 
@@ -45,29 +44,25 @@ import numpy as np
 
 from dgraph_tpu import ops
 from dgraph_tpu.ops.sets import SENT
+from dgraph_tpu.utils import planconfig
 
-# minimum estimated level-0 fan-out before fusing pays for itself.
-# Matches DGRAPH_TPU_EXPAND_DEVICE_MIN by design: once individual levels
-# would dispatch to the device anyway, one fused dispatch strictly beats
-# one per level; below it, host numpy wins on transport latency.
-# Fan-out (estimated total edges) below which fusing is not attempted.
-# Provenance: at the measured ~0.14 ms per-query fixed overhead and the
-# r4 profile's per-edge device win, the break-even sits well under this;
-# 256k keeps a safety margin for the host-side cap planning + packed-
-# buffer conversion the fused path adds (both scale with capacity, not
-# fan-out).  Tunable per deployment; bench21m records `chain_reject`
-# with the estimate whenever the threshold declines a chain, so the
-# setting is auditable against real workloads.
-CHAIN_THRESHOLD = int(os.environ.get("DGRAPH_TPU_CHAIN_THRESHOLD", 262144))
+# minimum estimated fan-out before fusing pays for itself (STATIC
+# fallback; the default route decision is the calibrated cost compare in
+# query/planner.py::chain_route, which prices one fused program against
+# per-level execution from measured per-kernel rates).  This threshold
+# governs when the planner is off (DGRAPH_TPU_PLANNER=0), the env knob
+# is pinned, or a caller assigned engine.chain_threshold directly.
+# Knob table + provenance: utils/planconfig.py.  bench21m records
+# `chain_reject` with the estimate whenever a chain is declined, so
+# either gate is auditable against real workloads.
+CHAIN_THRESHOLD = planconfig.chain_threshold()
 # abandon plans whose per-level output would exceed this many chunks.
 # Full-mode chains transfer their matrices, so the cap is transfer-sized;
 # light-mode (var-block) chains keep matrices on device and only ship
 # frontiers — they can afford much larger device buffers (a 2^23-chunk
 # level is 256MB of HBM but ~2MB on the wire).
-CHAIN_MAX_CAPC = int(os.environ.get("DGRAPH_TPU_CHAIN_MAX_CAPC", 1 << 21))
-CHAIN_MAX_CAPC_LIGHT = int(
-    os.environ.get("DGRAPH_TPU_CHAIN_MAX_CAPC_LIGHT", 1 << 23)
-)
+CHAIN_MAX_CAPC = planconfig.chain_max_capc()
+CHAIN_MAX_CAPC_LIGHT = planconfig.chain_max_capc_light()
 
 
 def _filter_fusable(ft) -> bool:
@@ -373,11 +368,31 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
         lvl = int(est_u * (a.n_edges / max(1, a.n_rows)))
         est_total += lvl
         est_u = lvl
-    if est_total < engine.chain_threshold:
+    # route decision: calibrated cost compare by default, the static
+    # threshold when the planner is off or the knob is pinned
+    # (query/planner.py::chain_route; plan_dec is None on the static
+    # path so the legacy reject message stays byte-identical)
+    from dgraph_tpu.query import planner
+
+    fuse, plan_dec = planner.chain_route(engine, est_total, len(levels))
+    if not fuse:
+        if plan_dec is not None:
+            # the per-level verdict is final — record it now
+            planner.record(engine.stats, plan_dec)
+            return reject(
+                f"fan-out estimate {est_total}: calibrated model favors "
+                f"per-level ({plan_dec['est_other_us']}us fused vs "
+                f"{plan_dec['est_chosen_us']}us per-level)"
+            )
         return reject(
             f"fan-out estimate {est_total} below threshold "
             f"{engine.chain_threshold}"
         )
+    # a fuse=True decision is recorded only at the SUCCESS sites below:
+    # a structural reject past this point (unresolvable filter, capacity
+    # over cap) falls back to per-level execution, and the ring/metric
+    # must not claim a fused chain that never ran (chain_reject already
+    # explains those falls)
     # var blocks encode nothing, so result matrices never leave the device
     # (unless a level participates in @cascade, which prunes matrices)
     light = bool(
@@ -439,6 +454,11 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
         and getattr(engine.expander, "fused_hop", "0") != "0"
         and _try_chain_scan(engine, levels, arenas[0], src, est_edges, universe)
     ):
+        # the chain RAN: record the decision and hand it to the engine's
+        # chain_ms bracket for the post-hoc mispredict check
+        if plan_dec is not None:
+            planner.record(engine.stats, plan_dec)
+        engine._pending_chain_dec = plan_dec
         return True
 
     caps: List[Tuple[int, int, int, bool, bool, Optional[tuple]]] = []
@@ -547,6 +567,9 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
             seg_ptr = seg_ptr0
         sg.chain_stash = ("full", out_flat, seg_ptr, src_list)
         src_list = nxt[nxt != SENT].astype(np.int64)
+    if plan_dec is not None:
+        planner.record(engine.stats, plan_dec)
+    engine._pending_chain_dec = plan_dec
     return True
 
 

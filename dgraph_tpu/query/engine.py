@@ -12,7 +12,6 @@ reference draws at the ServeTask boundary (SURVEY.md §2c).
 
 from __future__ import annotations
 
-import os
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -30,7 +29,8 @@ from dgraph_tpu.models.store import PostingStore
 from dgraph_tpu.models.types import TypeID, TypedValue, numeric, sort_key
 from dgraph_tpu.query.functions import FuncResolver, QueryError
 from dgraph_tpu.query.subgraph import SubGraph, build_subgraph
-from dgraph_tpu.query import outputnode
+from dgraph_tpu.query import outputnode, planner
+from dgraph_tpu.utils import planconfig
 
 _EMPTY = np.empty(0, dtype=np.int64)
 
@@ -97,6 +97,8 @@ def _fresh_stats() -> dict:
         "kway_host": 0,
         "host_expand_ms": 0.0,
         "device_expand_ms": 0.0,
+        "kway_ms": 0.0,
+        "resolver_expand_ms": 0.0,
         "chain_ms": 0.0,
         "device_order_ms": 0.0,
         "tile_build_ms": 0.0,
@@ -133,7 +135,7 @@ class DeviceExpander:
 
     def __init__(self, engine: "QueryEngine"):
         self.engine = engine
-        self.fused_hop = os.environ.get("DGRAPH_TPU_FUSED_HOP", "1")
+        self.fused_hop = planconfig.fused_hop()
         # cross-session hop coalescing: the cohort scheduler
         # (sched/scheduler.py) installs one HopMerger per cohort so
         # same-(arena, predicate, direction) expansions from different
@@ -146,6 +148,11 @@ class DeviceExpander:
         # say WHERE the time went, not just how much
         self._span = None
         self._route = ""
+        # last host-vs-device decision made by the planner inside
+        # _expand_one_inner; the _expand_one wrapper closes it with the
+        # measured stage latency (post-hoc mispredict check + online
+        # rate refinement)
+        self._expand_dec = None
 
     def _use_classed(self) -> bool:
         if self.fused_hop == "0":
@@ -226,7 +233,12 @@ class DeviceExpander:
             self.hop_merger is not None
             and attr
             and len(src)
-            and len(src) * arena.avg_degree >= self.engine.expand_device_min
+            # merge only where the union expansion would device-route:
+            # calibrated break-even by default, the static
+            # expand_device_min when the planner is off / knob pinned
+            and planner.merge_gate(
+                len(src) * arena.avg_degree, self.engine.expand_device_min
+            )
         ):
             self._route = "merged"
             out, seg_ptr = self.submit_hop(arena, src, attr, reverse)
@@ -263,6 +275,26 @@ class DeviceExpander:
     def _expand_one(
         self, arena, src: np.ndarray, attr: str = "", reverse: bool = False
     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Wrapper around the actual expansion: closes the planner's
+        host-vs-device decision (made inside, where the exact fan-out is
+        known) with the measured stage latency — the post-hoc mispredict
+        check and the online rate refinement both feed off this."""
+        st = self.engine.stats
+        before = st["device_expand_ms"] + st["host_expand_ms"]
+        self._expand_dec = None
+        out, seg_ptr = self._expand_one_inner(
+            arena, src, attr=attr, reverse=reverse
+        )
+        dec = self._expand_dec
+        if dec is not None:
+            self._expand_dec = None
+            actual_ms = st["device_expand_ms"] + st["host_expand_ms"] - before
+            planner.note_outcome(dec, actual_ms * 1e3)
+        return out, seg_ptr
+
+    def _expand_one_inner(
+        self, arena, src: np.ndarray, attr: str = "", reverse: bool = False
+    ) -> Tuple[np.ndarray, np.ndarray]:
         """One batched device gather for a whole level (the TPU replacement
         for the reference's per-key loop, worker/task.go:287-440).  Big
         predicates on a multi-device mesh expand sharded: each device owns
@@ -290,11 +322,19 @@ class DeviceExpander:
                 )
             eng.stats["edges"] += len(out)
             return out, seg_ptr
-        if total < eng.expand_device_min:
+        # host-vs-device: calibrated break-even by default (the
+        # size-adaptive routing the reference does per-intersection,
+        # algo/uidlist.go:56-64, priced from MEASURED rates instead of a
+        # magic number); static expand_device_min compare when the
+        # planner is off or the knob is pinned
+        use_device, dec = planner.expand_route(total, eng.expand_device_min)
+        if dec is not None:
+            planner.record(eng.stats, dec)
+            self._expand_dec = dec
+        if not use_device:
             # small expansion: vectorized numpy over the host CSR mirror —
             # a device dispatch costs a transport round trip that dwarfs
-            # the work (the size-adaptive routing the reference does
-            # per-intersection, algo/uidlist.go:56-64, done per-level)
+            # the work
             self._route = "host"
             with obs.stage(eng.stats, "host_expand_ms"):
                 out, seg_ptr = arena.expand_host(rows)
@@ -393,11 +433,16 @@ class QueryEngine:
                 budget_bytes=arena_budget_bytes,
             )
         )
-        from dgraph_tpu.query.chain import CHAIN_THRESHOLD
-
         # minimum estimated fan-out before chains fuse into one device
-        # program (below it, per-level host orchestration wins on latency)
-        self.chain_threshold = CHAIN_THRESHOLD
+        # program (below it, per-level host orchestration wins on
+        # latency).  The value is the STATIC gate: while it sits at the
+        # planconfig default and DGRAPH_TPU_PLANNER is on, the
+        # calibrated cost model (query/planner.py) makes the call
+        # instead; assigning it (tests, bench A/B arms) pins the gate
+        self.chain_threshold = planconfig.chain_threshold()
+        # chain decision awaiting its post-hoc latency check (see
+        # _exec_child's chain_ms bracket)
+        self._pending_chain_dec = None
         # per-level expansion routing, incl. the fused batched hop path
         # (ops/batch.py) — see DeviceExpander
         self.expander = DeviceExpander(self)
@@ -777,8 +822,15 @@ class QueryEngine:
 
             # failed attempts count too: planning cost must show up in
             # SOME bucket or the breakdown misleads
+            c0 = self.stats["chain_ms"]
             with obs.stage(self.stats, "chain_ms"):
                 try_run_chain(self, child, src, resolver)
+            # close the planner's chain decision with the measured
+            # latency (set only when a planner-routed chain actually ran)
+            cdec = getattr(self, "_pending_chain_dec", None)
+            if cdec is not None:
+                self._pending_chain_dec = None
+                planner.note_outcome(cdec, (self.stats["chain_ms"] - c0) * 1e3)
         if child.chain_stash is not None and child.chain_stash[0] == "light":
             _tag, dest, stash_src, n_edges = child.chain_stash
             child.chain_stash = None

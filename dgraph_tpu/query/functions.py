@@ -15,7 +15,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from dgraph_tpu import ops
+from dgraph_tpu import obs, ops
 from dgraph_tpu.ops.sets import SENT
 from dgraph_tpu import tok as tokmod
 from dgraph_tpu.models import geo as geomod
@@ -121,7 +121,13 @@ class FuncResolver:
 
     def _expand_rows(self, arena, rows: np.ndarray) -> np.ndarray:
         """Union of the posting lists at ``rows`` (expand + unique),
-        size-routed host/device like QueryEngine._expand."""
+        size-routed host/device like QueryEngine._expand — through the
+        SAME calibrated break-even (query/planner.py::expand_route; the
+        static expand_device_min compare when the planner is off or the
+        knob is pinned), so resolver expansions are priced and recorded
+        like engine-level ones."""
+        from dgraph_tpu.query import planner
+
         rows = np.asarray(rows, dtype=np.int64)
         rows = rows[rows >= 0]
         if rows.size == 0 or arena.n_edges == 0:
@@ -129,17 +135,34 @@ class FuncResolver:
         total = int(arena.degree_of_rows(rows).sum())
         if total == 0:
             return _EMPTY
-        if total < self.arenas.expand_device_min:
-            out, _seg = arena.expand_host(rows)
-            return np.unique(out)
-        cap = ops.bucket(total)
-        if hasattr(arena, "ensure_device"):
-            arena.ensure_device()  # stale after incremental host deltas
-        out, _seg, _t = ops.expand_csr(
-            arena.offsets, arena.dst, ops.pad_rows(rows, ops.bucket(len(rows))), cap
+        use_device, dec = planner.expand_route(
+            total, self.arenas.expand_device_min
         )
-        u = np.asarray(ops.sort_unique(out))
-        return u[u != SENT].astype(np.int64)
+        if dec is not None:
+            planner.record(self.stats, dec)
+        # recorded decisions must also be CLOSED (note_outcome), or
+        # resolver traffic would inflate the mispredict-rate denominator
+        # with entries that can never be checked
+        st = self.stats if self.stats is not None else {}
+        r0 = st.get("resolver_expand_ms", 0.0)
+        if not use_device:
+            with obs.stage(st, "resolver_expand_ms"):
+                out, _seg = arena.expand_host(rows)
+                res = np.unique(out)
+            planner.note_outcome(dec, (st["resolver_expand_ms"] - r0) * 1e3)
+            return res
+        with obs.stage(st, "resolver_expand_ms"):
+            cap = ops.bucket(total)
+            if hasattr(arena, "ensure_device"):
+                arena.ensure_device()  # stale after incremental host deltas
+            out, _seg, _t = ops.expand_csr(
+                arena.offsets, arena.dst,
+                ops.pad_rows(rows, ops.bucket(len(rows))), cap,
+            )
+            u = np.asarray(ops.sort_unique(out))
+            res = u[u != SENT].astype(np.int64)
+        planner.note_outcome(dec, (st["resolver_expand_ms"] - r0) * 1e3)
+        return res
 
     def _pred_index(self, pred: str, prefer_sortable: bool) -> IndexArena:
         toks = self.store.schema.tokenizers(pred)

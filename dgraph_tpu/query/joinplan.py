@@ -35,7 +35,6 @@ applies; tests and benches pin routes with it).
 
 from __future__ import annotations
 
-import os
 import threading
 from collections import deque
 from typing import List, Optional
@@ -44,40 +43,47 @@ import numpy as np
 
 from dgraph_tpu import obs, ops
 from dgraph_tpu.ops.sets import SENT
+from dgraph_tpu.utils import planconfig
 from dgraph_tpu.utils.metrics import JOIN_ROUTES, KWAY_INTERSECTS
 
 _EMPTY = np.empty(0, dtype=np.int64)
 
-# -- cost-model constants (µs) ------------------------------------------------
-# Deliberately coarse: the decision only has to be RIGHT about which
-# side of a ~100× shape gap a query sits on, and every decision is
-# recorded with both estimates so a mis-tune is visible in the stats.
-DISPATCH_US = 120.0        # fixed cost of one host-driven device program
-GATHER_US_PER_EDGE = 0.02  # per-edge gather + host conversion, gather tier
-TILE_MAC_US = 1.2e-4       # per T·T MAC lane of a stored tile per pass
-                           # (≈2µs for an MXU-native 128×128 tile)
-COMBINE_US_PER_MAC = 2e-5  # one-hot block-column combine, per K·NB·T MAC
-TILE_BUILD_US_PER_LANE = 1.8e-4  # host densify + upload, per tile lane
-TILE_BUILD_AMORTIZE = 8.0  # expected reuses of a freshly built tile set
+# Cost rates (µs) come from the planner's calibrated model
+# (query/planner.py::rates — priors in utils/calibrate.py, refined by
+# the startup micro-calibration pass and online from per-hop timings).
+# The decision only has to be RIGHT about which side of a ~100× shape
+# gap a query sits on, and every decision is recorded with both
+# estimates so a mis-calibration is visible in the stats.  With the
+# planner OFF (DGRAPH_TPU_PLANNER=0) the original PR-9 constants below
+# drive the compare verbatim, so the kill switch restores the legacy
+# route choice exactly.
+_PR9_RATES = {
+    "dispatch_us": 120.0,
+    "tile_mac_us": 1.2e-4,
+    "combine_us_per_mac": 2e-5,
+    "tile_build_us_per_lane": 1.8e-4,
+    "tile_build_amortize": 8.0,
+}
 
 
 def mxu_mode() -> str:
     """DGRAPH_TPU_MXU_JOIN: '0' off, '1' auto (default), 'force' always
     (structural eligibility permitting).  Read per call so serving tests
     flip it without rebooting."""
-    return os.environ.get("DGRAPH_TPU_MXU_JOIN", "1")
+    return planconfig.mxu_mode()
 
 
 def kway_device_min() -> int:
     """Total candidate elements below which a k-way intersection stays
-    on the host fold (a device dispatch costs a transport round trip)."""
-    return int(os.environ.get("DGRAPH_TPU_KWAY_DEVICE_MIN", 262144))
+    on the host fold (STATIC fallback — the planner prices the fold
+    against the batched device program instead when it is armed)."""
+    return planconfig.kway_device_min()
 
 
 def mask_max_lanes() -> int:
     """Largest frontier-mask length the mxu chain route may allocate
     (float32 lanes; 1<<22 ≈ 16MB per mask)."""
-    return int(os.environ.get("DGRAPH_TPU_MXU_MASK_MAX", 1 << 22))
+    return planconfig.mask_max_lanes()
 
 
 # -- decision recording -------------------------------------------------------
@@ -125,6 +131,8 @@ def kway_intersect(
     batched device program above the gate, the numpy fold below it.
     Byte-identical to the ``np.intersect1d`` fold by construction
     (sorted-unique int64 either way)."""
+    from dgraph_tpu.query import planner
+
     sets = [np.asarray(s, dtype=np.int64) for s in sets]
     if not sets:
         return _EMPTY
@@ -134,32 +142,46 @@ def kway_intersect(
         return _EMPTY
     total = sum(len(s) for s in sets)
     k = len(sets)
-    use_device = (
-        mxu_mode() != "0"
-        and k <= 16
-        and (total >= kway_device_min() or mxu_mode() == "force")
-    )
+    mode = mxu_mode()
+    dec = None
+    if mode == "0" or k > 16:
+        use_device = False
+    elif mode == "force":
+        use_device = True
+    else:
+        # calibrated fold-vs-device break-even; static size gate when
+        # the planner is off or DGRAPH_TPU_KWAY_DEVICE_MIN is pinned
+        use_device, dec = planner.kway_route(total, k)
+        if use_device is None:
+            use_device = total >= kway_device_min()
+    if dec is not None:
+        planner.record(stats, dec)
+    k0 = stats.get("kway_ms", 0.0) if stats is not None else 0.0
     if use_device:
         import jax.numpy as jnp
 
-        L = ops.bucket(max(len(s) for s in sets))
-        mat = np.stack([ops.pad_to(s, L) for s in sets])
-        out = np.asarray(ops.intersect_stack(jnp.asarray(mat)))
-        res = out[out != SENT].astype(np.int64)
+        with obs.stage(stats if stats is not None else {}, "kway_ms"):
+            L = ops.bucket(max(len(s) for s in sets))
+            mat = np.stack([ops.pad_to(s, L) for s in sets])
+            out = np.asarray(ops.intersect_stack(jnp.asarray(mat)))
+            res = out[out != SENT].astype(np.int64)
         KWAY_INTERSECTS.add("device")
         with _ROUTE_LOCK:
             _COUNTS["kway_device"] += 1
         if stats is not None:
             stats["kway_device"] = stats.get("kway_device", 0) + 1
+            planner.note_outcome(dec, (stats["kway_ms"] - k0) * 1e3)
         return res
-    out = sets[0]
-    for s in sets[1:]:
-        out = np.intersect1d(out, s)
+    with obs.stage(stats if stats is not None else {}, "kway_ms"):
+        out = sets[0]
+        for s in sets[1:]:
+            out = np.intersect1d(out, s)
     KWAY_INTERSECTS.add("host")
     with _ROUTE_LOCK:
         _COUNTS["kway_host"] += 1
     if stats is not None:
         stats["kway_host"] = stats.get("kway_host", 0) + 1
+        planner.note_outcome(dec, (stats["kway_ms"] - k0) * 1e3)
     return out
 
 
@@ -288,7 +310,13 @@ def try_mxu_route(engine, child, src: np.ndarray, resolver) -> bool:
         lvl = int(est_u * a.avg_degree)
         est_total += lvl
         est_u = lvl
-    if est_total < engine.chain_threshold and mode != "force":
+    # fan-out admission shares the chain tier's calibrated break-even
+    # (static threshold when the planner is off / the knob is pinned)
+    from dgraph_tpu.query import planner
+
+    if mode != "force" and not planner.mxu_fanout_ok(
+        engine, est_total, len(levels)
+    ):
         return False
 
     # --- structural feasibility: tiles + mask sizes ---
@@ -342,20 +370,37 @@ def try_mxu_route(engine, child, src: np.ndarray, resolver) -> bool:
             if nz[-1] >= mean_cls + 4:
                 pad = 2.0
                 break
-    est_pairwise = (
-        len(levels) * DISPATCH_US + est_total * GATHER_US_PER_EDGE * pad
+    # rate table: the planner's live (calibrated, online-refined) rates
+    # when it is armed; the PR-9 constants VERBATIM when it is off, so
+    # DGRAPH_TPU_PLANNER=0 restores the original mxu-vs-pairwise compare
+    # exactly (gather_edge_us is the old GATHER_US_PER_EDGE — the gather
+    # tier's per-edge cost including host conversion)
+    planner_on = planner.enabled()
+    if planner_on:
+        r = planner.rates()
+        # the gather tier's per-edge cost is device gather PLUS the
+        # per-level host conversion/dedup — the same decomposition
+        # chain_route charges, and the model's split of PR-9's flat
+        # GATHER_US_PER_EDGE=0.02 (pricing it at device_edge alone
+        # would under-admit the MXU tier relative to both)
+        gather_edge_us = r["device_edge_us"] + r["host_touch_us"]
+    else:
+        r = _PR9_RATES
+        gather_edge_us = 0.02
+    est_pairwise = len(levels) * r["dispatch_us"] + est_total * (
+        gather_edge_us * pad
     )
     nbm = m // t
     per_pass = sum(
-        k * t * t * TILE_MAC_US + k * nbm * t * COMBINE_US_PER_MAC
+        k * t * t * r["tile_mac_us"] + k * nbm * t * r["combine_us_per_mac"]
         for k in blocks
     )
     build = sum(
-        k * t * t * TILE_BUILD_US_PER_LANE
+        k * t * t * r["tile_build_us_per_lane"]
         for a, k in zip(arenas, blocks)
         if a._tiles is None
     )
-    est_mxu = DISPATCH_US + per_pass + build / TILE_BUILD_AMORTIZE
+    est_mxu = r["dispatch_us"] + per_pass + build / r["tile_build_amortize"]
     if mode != "force" and est_mxu >= est_pairwise:
         record_route(engine.stats, _decision(
             "pairwise", levels, est_total, est_pairwise, est_mxu,
@@ -398,6 +443,20 @@ def try_mxu_route(engine, child, src: np.ndarray, resolver) -> bool:
         "mxu", levels, est_total, est_pairwise, est_mxu,
         reason="generic join over densified tiles",
     ))
+    # twin entry in the unified planner ring (kind=mxu) so the post-hoc
+    # mispredict check covers the tile tier too — only while the planner
+    # is armed (=0 must leave /debug/planner counts and the mispredict
+    # metric untouched; the join ring above keeps full PR-9 visibility)
+    pdec = None
+    if planner_on:
+        pdec = {
+            "kind": "mxu", "route": "mxu", "units": int(est_total),
+            "est_chosen_us": round(float(est_mxu), 1),
+            "est_other_us": round(float(est_pairwise), 1),
+            "reason": "generic join over densified tiles",
+        }
+        planner.record(engine.stats, pdec)
+    mxu_ms0 = engine.stats.get("mxu_join_ms", 0.0)
 
     sp = obs.current_span()
     hs = sp.child("hop") if sp is not None else obs.NOOP
@@ -435,6 +494,9 @@ def try_mxu_route(engine, child, src: np.ndarray, resolver) -> bool:
             )
         masks = np.asarray(masks_dev)
         totals = np.asarray(totals_dev)
+    planner.note_outcome(
+        pdec, (engine.stats.get("mxu_join_ms", 0.0) - mxu_ms0) * 1e3
+    )
 
     # --- stage light-mode stashes (the chain consumer's contract) ---
     src_list: Optional[np.ndarray] = src32

@@ -1,0 +1,501 @@
+"""Measured-cost adaptive planner: ONE calibrated model for every route.
+
+The engine has five execution routes (serial per-op, fused classed,
+chain-scan, fused recurse, MXU tile join) plus the host-vs-device k-way
+intersection.  Until PR 10 each was gated by its own magic number — two
+independently-grown ``262144`` twins among them — and BENCH21M showed
+the cost: ``chain_reject: "fan-out estimate 168342 below threshold
+262144"`` kept the chain scan out of hot 3-hop queries it measurably
+wins.  Banyan (PAPERS.md) frames graph serving as scoped dataflow with
+per-scope scheduling choices; EmptyHeaded's cost-based plan choice
+already drives PR 9's join tier.  This module generalizes that: every
+route decision prices its candidates from MEASURED per-kernel
+throughput and picks the cheaper one.
+
+Structure:
+
+- **Rates** come from ``utils/calibrate.py``: shipped priors → persisted
+  calibration file → startup micro-calibration (``boot(measure=True)``),
+  then refined ONLINE from the per-hop stage timings the engine already
+  records — ``note_outcome`` folds each decision's actual latency back
+  into an EWMA of the chosen route's per-unit rate.
+- **Decisions** (``chain_route`` / ``expand_route`` / ``kway_route`` /
+  ``merge_gate``) replace the static threshold compares in
+  ``query/chain.py``, ``query/joinplan.py``, ``query/engine.py`` and the
+  resolver path.  Each returns the chosen route WITH both cost
+  estimates, recorded in the per-request ``engine.stats["planner"]``
+  (the ``chain_reject`` explainability discipline), a process ring
+  behind ``/debug/planner``, and
+  ``dgraph_planner_decisions_total{kind,route}``.
+- **Post-hoc mispredict check**: when the chosen route's measured
+  latency lands above the REJECTED route's estimate (with margin) — or
+  blows past its own estimate entirely — the decision is flagged and
+  ``dgraph_planner_mispredict_total{kind}`` increments.  A rising
+  mispredict rate is the operator's signal to re-run calibration.
+- **Cohort feedback** (``CohortController``): the scheduler's cohort
+  size and flush deadline adapt to measured queue-wait and cohort
+  occupancy inside hard bounds, instead of fixed ``DGRAPH_TPU_SCHED``
+  knobs.
+
+Override discipline: ``DGRAPH_TPU_PLANNER=0`` restores every static
+threshold byte-identically, and ANY explicitly pinned knob (env value
+or runtime assignment like ``engine.chain_threshold = 0`` in tests)
+wins over the model for that gate — calibration never overrules an
+operator.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional, Tuple
+
+from dgraph_tpu.utils import planconfig
+from dgraph_tpu.utils.calibrate import PRIORS, Calibration, load, measure, save
+from dgraph_tpu.utils.metrics import (
+    PLANNER_CALIBRATIONS,
+    PLANNER_DECISIONS,
+    PLANNER_MISPREDICTS,
+)
+
+# decision units below which a measured latency is dispatch-dominated
+# noise: no rate refinement, no mispredict verdict
+_MIN_UNITS_FOR_RATE = 512
+_EWMA_ALPHA = 0.2
+# mispredict margins: wrong-side needs 1.5× past the rejected estimate,
+# own-estimate blowout needs 8× — both loose enough that host noise on a
+# 2-core CI box doesn't page anyone, tight enough that a stale
+# calibration shows up within a bench round
+_MISPREDICT_OTHER_MARGIN = 1.5
+_MISPREDICT_SELF_MARGIN = 8.0
+# observations past this multiple of the route's own estimate are cold
+# compiles / host outliers, not routing evidence
+_OUTLIER_FACTOR = 100.0
+
+_LOCK = threading.Lock()
+_RECENT: "deque[dict]" = deque(maxlen=64)
+_COUNTS: dict = {}
+_MISPREDICTS: dict = {}
+_CAL: Calibration = PRIORS
+_RATES: dict = PRIORS.rates()  # live copy the EWMA refines
+
+
+def enabled() -> bool:
+    return planconfig.planner_enabled()
+
+
+# -- calibration lifecycle ---------------------------------------------------
+
+
+def boot(measure_now: bool = False) -> Calibration:
+    """Install the best available calibration.
+
+    ``measure_now=False`` (every server construction): load a valid
+    persisted file — the warm-boot path that skips the measurement pass
+    — else keep the current rates (priors on a cold process).
+
+    ``measure_now=True`` (``DGRAPH_TPU_CALIBRATE=1`` boots, every
+    bench.py round): RE-measure unconditionally and persist, replacing
+    any existing file — this is the documented stale-calibration remedy,
+    so it must never be short-circuited by the very file it is meant to
+    refresh."""
+    global _CAL
+    path = planconfig.calibration_file()
+    backend = None
+    if path or measure_now:
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:  # noqa: BLE001 — no backend = keep priors
+            backend = None
+    cal = None
+    if measure_now and backend is not None:
+        cal = measure()
+        PLANNER_CALIBRATIONS.add()
+        if path:
+            try:
+                save(cal, path)
+            except OSError:
+                pass  # read-only disk: serve from the in-memory rates
+    if cal is None and path and backend:
+        # the backend gate is unconditional: with no known backend the
+        # file is NOT loaded (a TPU calibration must never price a CPU
+        # boot, and an unknown boot must never trust either kind)
+        cal = load(path, backend=backend)
+    if cal is not None:
+        with _LOCK:
+            _CAL = cal
+            _RATES.update(cal.rates())
+    return _CAL
+
+
+def install_calibration(cal: Calibration) -> None:
+    """Adopt an explicit calibration (tests, operator tooling)."""
+    global _CAL
+    with _LOCK:
+        _CAL = cal
+        _RATES.update(cal.rates())
+
+
+def rates() -> dict:
+    """Snapshot of the live (online-refined) rate table, µs units."""
+    with _LOCK:
+        return dict(_RATES)
+
+
+def calibration_info() -> dict:
+    with _LOCK:
+        return {
+            "source": _CAL.source,
+            "backend": _CAL.backend,
+            "measured_at": _CAL.measured_at,
+            "rates": dict(_RATES),
+        }
+
+
+# -- decision recording ------------------------------------------------------
+
+
+def record(stats: Optional[dict], dec: dict) -> None:
+    """Log one routing decision everywhere it must be visible: the
+    bounded per-request stats list, the process ring behind
+    /debug/planner, and the prometheus counter."""
+    PLANNER_DECISIONS.add((dec["kind"], dec["route"]))
+    with _LOCK:
+        _RECENT.append(dec)
+        k = (dec["kind"], dec["route"])
+        _COUNTS[k] = _COUNTS.get(k, 0) + 1
+    if stats is not None:
+        lst = stats.setdefault("planner", [])
+        if len(lst) < 8:
+            lst.append(dec)
+
+
+def note_outcome(dec: Optional[dict], actual_us: float) -> None:
+    """Post-hoc check of one recorded decision: refine the chosen
+    route's rate EWMA from the measured latency and flag a mispredict
+    when the model picked the wrong side."""
+    if dec is None or actual_us <= 0.0:
+        return
+    units = int(dec.get("units", 0))
+    est_self = float(dec.get("est_chosen_us", 0.0))
+    est_other = float(dec.get("est_other_us", 0.0))
+    # a first-time shape's XLA compile (or a host page-fault storm)
+    # dwarfs any honest execution estimate: recorded for the ring, but
+    # it must neither poison the rate EWMA nor count as a mispredict —
+    # decisions have to stay deterministic for a steady shape (the
+    # zero-new-programs guard depends on it)
+    outlier = est_self > 0 and actual_us > est_self * _OUTLIER_FACTOR
+    wrong_side = est_other > 0 and actual_us > est_other * _MISPREDICT_OTHER_MARGIN
+    blowout = est_self > 0 and actual_us > est_self * _MISPREDICT_SELF_MARGIN
+    mispredict = (
+        not outlier
+        and units >= _MIN_UNITS_FOR_RATE  # dispatch-dominated: no verdict
+        and (wrong_side or blowout)
+    )
+    # dec is already published to the process ring: mutate it ONLY under
+    # the lock, and debug_summary snapshots per-entry copies under the
+    # same lock — /debug/planner must never json.dumps a dict another
+    # thread is growing
+    with _LOCK:
+        dec["actual_us"] = round(float(actual_us), 1)
+        if outlier:
+            dec["outlier"] = True
+        if mispredict:
+            dec["mispredict"] = True
+            _MISPREDICTS[dec["kind"]] = _MISPREDICTS.get(dec["kind"], 0) + 1
+    if mispredict:
+        PLANNER_MISPREDICTS.add(dec["kind"])
+    if not outlier:
+        _refine(dec["kind"], dec["route"], units, actual_us)
+
+
+# chain/mxu timings are composite (capacity planning + packing + the
+# kernel) and deliberately refine nothing — only the leaf routes teach
+# the model their per-unit rates
+_RATE_KEY = {
+    ("expand", "host"): ("host_edge_us", 0.0),
+    ("expand", "device"): ("device_edge_us", 1.0),   # minus one dispatch
+    ("kway", "host"): ("host_intersect_us", 0.0),
+    ("kway", "device"): ("device_intersect_us", 1.0),
+}
+
+
+def _refine(kind: str, route: str, units: int, actual_us: float) -> None:
+    """EWMA-refine the per-unit rate of the route that actually ran.
+    Observed rates clamp to prior/64..prior×64 so one GC pause or page
+    fault cannot poison the model."""
+    key = _RATE_KEY.get((kind, route))
+    if key is None or units < _MIN_UNITS_FOR_RATE:
+        return
+    field, dispatches = key
+    with _LOCK:
+        work_us = actual_us - dispatches * _RATES["dispatch_us"]
+        if work_us <= 0:
+            return
+        obs = work_us / units
+        prior = getattr(PRIORS, field)
+        obs = min(max(obs, prior / 64.0), prior * 64.0)
+        _RATES[field] = (1 - _EWMA_ALPHA) * _RATES[field] + _EWMA_ALPHA * obs
+
+
+# -- route decisions ---------------------------------------------------------
+
+
+def chain_route(
+    engine, est_total: int, n_levels: int
+) -> Tuple[bool, Optional[dict]]:
+    """Fuse this chain into one device program, or run it per level?
+
+    Static path (planner off, env-pinned threshold, or a runtime
+    ``engine.chain_threshold`` assignment): the legacy
+    ``est_total >= threshold`` compare, decision dict None so callers
+    keep the legacy reject message byte-identically.
+
+    Planner path: price the whole chain both ways —
+      per-level = min(host numpy, per-level device dispatches + the
+                      host conversion/dedup each level pays)
+      chain     = one dispatch + capacity planning + device edge rate
+    and fuse when the chain is cheaper.  The measured break-even sits
+    around a few tens of thousands of edges on the CPU bench host —
+    which is exactly why the BENCH21M 168342-edge 3-hop shape belongs on
+    the chain scan that the static 262144 gate refused it."""
+    if (
+        not enabled()
+        or planconfig.overridden("DGRAPH_TPU_CHAIN_THRESHOLD")
+        or engine.chain_threshold != planconfig.CHAIN_THRESHOLD_DEFAULT
+    ):
+        return est_total >= engine.chain_threshold, None
+    r = rates()
+    host_c = n_levels * r["host_setup_us"] + est_total * r["host_edge_us"]
+    dev_c = n_levels * r["dispatch_us"] + est_total * (
+        r["device_edge_us"] + r["host_touch_us"]
+    )
+    per_level = min(host_c, dev_c)
+    chain_c = (
+        r["dispatch_us"] + r["chain_plan_us"] + est_total * r["device_edge_us"]
+    )
+    fuse = chain_c < per_level
+    dec = {
+        "kind": "chain",
+        "route": "chain" if fuse else "perlevel",
+        "units": int(est_total),
+        "levels": int(n_levels),
+        "est_chosen_us": round(chain_c if fuse else per_level, 1),
+        "est_other_us": round(per_level if fuse else chain_c, 1),
+        "reason": (
+            "calibrated break-even favors one fused program"
+            if fuse
+            else "calibrated break-even favors per-level execution"
+        ),
+    }
+    return fuse, dec
+
+
+def expand_route(
+    total: int, configured_min: int
+) -> Tuple[bool, Optional[dict]]:
+    """Host numpy or one device dispatch for a single level's expansion?
+    Returns (use_device, decision).  Static compare when the planner is
+    off or the knob is pinned (env or runtime assignment)."""
+    if (
+        not enabled()
+        or planconfig.overridden("DGRAPH_TPU_EXPAND_DEVICE_MIN")
+        or configured_min != planconfig.EXPAND_DEVICE_MIN_DEFAULT
+    ):
+        return total >= configured_min, None
+    r = rates()
+    host_c = r["host_setup_us"] + total * r["host_edge_us"]
+    dev_c = r["dispatch_us"] + total * r["device_edge_us"]
+    use_device = dev_c < host_c
+    dec = {
+        "kind": "expand",
+        "route": "device" if use_device else "host",
+        "units": int(total),
+        "est_chosen_us": round(dev_c if use_device else host_c, 1),
+        "est_other_us": round(host_c if use_device else dev_c, 1),
+        "reason": "calibrated host/device break-even",
+    }
+    return use_device, dec
+
+
+def merge_gate(est_edges: float, configured_min: int) -> bool:
+    """Should a cohort hop-merge rendezvous admit this expansion?
+    Merging only amortizes when the union expansion device-routes, so
+    the gate IS the expand decision on the estimated fan-out (no
+    recording — the real expansion downstream records itself)."""
+    if (
+        not enabled()
+        or planconfig.overridden("DGRAPH_TPU_EXPAND_DEVICE_MIN")
+        or configured_min != planconfig.EXPAND_DEVICE_MIN_DEFAULT
+    ):
+        return est_edges >= configured_min
+    r = rates()
+    return (
+        r["dispatch_us"] + est_edges * r["device_edge_us"]
+        < r["host_setup_us"] + est_edges * r["host_edge_us"]
+    )
+
+
+def kway_route(total: int, k: int) -> Tuple[Optional[bool], Optional[dict]]:
+    """Host ``np.intersect1d`` fold or one batched device program for a
+    k-way intersection?  Returns (use_device, decision); (None, None)
+    means static gate (caller compares against the configured min)."""
+    if not enabled() or planconfig.overridden("DGRAPH_TPU_KWAY_DEVICE_MIN"):
+        return None, None
+    r = rates()
+    host_c = k * r["host_setup_us"] + total * r["host_intersect_us"]
+    dev_c = r["dispatch_us"] + total * r["device_intersect_us"]
+    use_device = dev_c < host_c
+    dec = {
+        "kind": "kway",
+        "route": "device" if use_device else "host",
+        "units": int(total),
+        "k": int(k),
+        "est_chosen_us": round(dev_c if use_device else host_c, 1),
+        "est_other_us": round(host_c if use_device else dev_c, 1),
+        "reason": "calibrated fold/device break-even",
+    }
+    return use_device, dec
+
+
+def mxu_fanout_ok(engine, est_total: int, n_levels: int) -> bool:
+    """The MXU tier's fan-out admission: is this chain big enough to
+    leave the host at all?  Shares chain_route's model (and its override
+    discipline) without recording — joinplan records the full mxu-vs-
+    pairwise decision itself."""
+    ok, _dec = chain_route(engine, est_total, n_levels)
+    return ok
+
+
+# -- scheduler feedback ------------------------------------------------------
+
+
+class CohortController:
+    """Load-adaptive cohort admission: max_batch and the flush deadline
+    move with MEASURED queue-wait and cohort occupancy, inside hard
+    bounds, instead of sitting at fixed ``DGRAPH_TPU_SCHED`` knobs.
+
+    Deterministic given the observation sequence (the seeded load-ramp
+    test replays one), and bounded by construction:
+
+      max_batch ∈ [base, min(8×base, 1024)]
+      flush deadline ∈ [base/8, base]
+
+    Rules per update (EWMA α=0.25 on occupancy and queue wait):
+    - sustained occupancy ≥ 3/4 of the current batch cap → the cap
+      doubles (arrivals are filling cohorts: batch harder);
+    - occupancy back under 1/4 of BASE → the cap halves toward base
+      (idle traffic must not wait for a giant cohort that never fills);
+    - queue wait blowing past 4× the flush deadline → the deadline
+      halves (drain faster under backlog);
+    - queue wait under 1/4 of the deadline → the deadline relaxes back
+      toward base.
+    """
+
+    def __init__(self, base_batch: int, base_flush_s: float):
+        self.base_batch = max(1, int(base_batch))
+        self.hi_batch = min(self.base_batch * 8, 1024)
+        self.base_flush_s = float(base_flush_s)
+        self.lo_flush_s = self.base_flush_s / 8.0
+        self.max_batch = self.base_batch
+        self.flush_s = self.base_flush_s
+        self._occ = 0.0
+        self._wait = 0.0
+        self._service = 0.0
+        self._updates = 0
+        self._lock = threading.Lock()
+
+    def update(
+        self, occupancy: int, queue_wait_s: float, service_s: float = 0.0
+    ) -> Tuple[int, float]:
+        """Fold one flush's measurements in; returns the (possibly
+        adjusted) (max_batch, flush_deadline_s)."""
+        a = 0.25
+        with self._lock:
+            self._occ = (1 - a) * self._occ + a * float(occupancy)
+            self._wait = (1 - a) * self._wait + a * float(queue_wait_s)
+            self._service = (1 - a) * self._service + a * float(service_s)
+            self._updates += 1
+            if self._occ >= 0.75 * self.max_batch and self.max_batch < self.hi_batch:
+                self.max_batch = min(self.max_batch * 2, self.hi_batch)
+            elif self._occ <= 0.25 * self.base_batch and self.max_batch > self.base_batch:
+                self.max_batch = max(self.max_batch // 2, self.base_batch)
+            if self._wait > 4.0 * self.flush_s and self.flush_s > self.lo_flush_s:
+                self.flush_s = max(self.flush_s * 0.5, self.lo_flush_s)
+            elif self._wait < 0.25 * self.flush_s and self.flush_s < self.base_flush_s:
+                self.flush_s = min(self.flush_s * 1.5, self.base_flush_s)
+            return self.max_batch, self.flush_s
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "max_batch": self.max_batch,
+                "flush_ms": round(self.flush_s * 1e3, 3),
+                "base_batch": self.base_batch,
+                "base_flush_ms": round(self.base_flush_s * 1e3, 3),
+                "occupancy_ewma": round(self._occ, 2),
+                "queue_wait_ms_ewma": round(self._wait * 1e3, 3),
+                "service_ms_ewma": round(self._service * 1e3, 3),
+                "updates": self._updates,
+            }
+
+
+# -- debug surface -----------------------------------------------------------
+
+
+def debug_summary(scheduler=None) -> dict:
+    """The unified /debug/planner view: calibration provenance, live
+    rates, per-(kind,route) decision counts, mispredicts, the recent
+    ring, the join tier's own ring (PR 9), and the scheduler's adaptive
+    state when one is attached."""
+    from dgraph_tpu.query import joinplan
+
+    with _LOCK:
+        counts = {f"{k}:{r}": v for (k, r), v in sorted(_COUNTS.items())}
+        mis = dict(_MISPREDICTS)
+        # per-entry copies: note_outcome mutates ring entries under this
+        # lock, so the snapshot must not share the dict objects
+        recent = [dict(d) for d in _RECENT]
+    out = {
+        "enabled": enabled(),
+        "calibration": calibration_info(),
+        "counts": counts,
+        "mispredicts": mis,
+        "mispredict_total": sum(mis.values()),
+        "recent": recent,
+        "join": joinplan.debug_summary(),
+    }
+    if scheduler is not None:
+        ctl = getattr(scheduler, "_adaptive", None)
+        out["sched"] = ctl.state() if ctl is not None else {
+            "adaptive": False,
+            "max_batch": scheduler.max_batch,
+            "flush_ms": round(scheduler.flush_s * 1e3, 3),
+        }
+    return out
+
+
+def mispredict_stats() -> dict:
+    """(decision_total, mispredict_total, rate) — the bench headline's
+    honesty row."""
+    with _LOCK:
+        total = sum(_COUNTS.values())
+        mis = sum(_MISPREDICTS.values())
+    return {
+        "decisions": total,
+        "mispredicts": mis,
+        "mispredict_rate": round(mis / total, 4) if total else 0.0,
+    }
+
+
+def _reset_for_tests() -> None:
+    global _CAL
+    with _LOCK:
+        _RECENT.clear()
+        _COUNTS.clear()
+        _MISPREDICTS.clear()
+        _CAL = PRIORS
+        _RATES.clear()
+        _RATES.update(PRIORS.rates())

@@ -45,6 +45,14 @@ all — singleflight's reuse window (while a twin is in flight) extended
 to the whole mutation epoch.  Gated by ``DGRAPH_TPU_CACHE`` (default
 on; ``0`` restores today's path byte-identically).
 
+Admission is LOAD-ADAPTIVE by default (PR 10): while the planner is on
+(``DGRAPH_TPU_PLANNER``) and neither knob is pinned, cohort size and the
+flush deadline track measured queue-wait and occupancy inside hard
+bounds — [base, 8×base] members, [base/8, base] deadline — via
+``query/planner.py::CohortController`` (state visible at
+``/debug/planner``).  Responses never depend on either knob, so the
+adaptation is byte-invisible; pinning any knob restores static values.
+
 Knobs (env): ``DGRAPH_TPU_SCHED`` (gate, default on; ``0`` restores the
 serial per-request path byte-identically), ``DGRAPH_TPU_SCHED_MAX_BATCH``
 (default 32), ``DGRAPH_TPU_SCHED_FLUSH_MS`` (default 2.0),
@@ -141,6 +149,26 @@ class CohortScheduler:
         # service time, which under zipf traffic is where the duplicates
         # actually are.
         self._inflight: Dict[object, list] = {}
+        # load-adaptive cohort admission (query/planner.py): cohort size
+        # and flush deadline move with MEASURED queue-wait and occupancy
+        # inside hard bounds ([base, 8×base] batch, [base/8, base]
+        # deadline) instead of sitting at the static knobs.  Armed only
+        # when the planner is on AND neither knob is pinned — an env
+        # value or a constructor argument is an operator override.
+        from dgraph_tpu.query import planner as _planner
+        from dgraph_tpu.utils import planconfig as _planconfig
+
+        self._adaptive = None
+        if (
+            _planner.enabled()
+            and max_batch is None
+            and flush_ms is None
+            and not _planconfig.overridden("DGRAPH_TPU_SCHED_MAX_BATCH")
+            and not _planconfig.overridden("DGRAPH_TPU_SCHED_FLUSH_MS")
+        ):
+            self._adaptive = _planner.CohortController(
+                self.max_batch, self.flush_s
+            )
         n_workers = int(
             concurrency
             if concurrency is not None
@@ -317,8 +345,11 @@ class CohortScheduler:
         SCHED_COHORT_OCCUPANCY.observe(len(cohort.reqs))
         now = time.monotonic()
         live: List[SchedRequest] = []
+        max_wait = 0.0
         for req in cohort.reqs:
-            SCHED_QUEUE_WAIT.observe(now - req.enqueued)
+            w = now - req.enqueued
+            max_wait = max(max_wait, w)
+            SCHED_QUEUE_WAIT.observe(w)
             if req.expired(now):
                 self._shed_deadline(req, now)
             else:
@@ -333,6 +364,11 @@ class CohortScheduler:
             SCHED_QUEUE_DEPTH.set(self._depth)
             self._flushes += 1
         if not live:
+            # a fully-shed cohort is the STRONGEST overload signal the
+            # controller can get — its queue waits must reach the EWMA
+            # or the flush deadline never tightens under exactly the
+            # backlog the adaptation exists for
+            self._adapt(len(cohort.reqs), max_wait, 0.0)
             return
         # singleflight: equal-key members are the same deterministic
         # computation — run the first of each key, deal its result to
@@ -443,6 +479,28 @@ class CohortScheduler:
                     "merged_hops", merger.merged_dispatches
                 )
                 flush_span.finish()
+            # feed this flush's measurements back: occupancy, the worst
+            # queue wait, and the cohort's service time.  The values are
+            # bounded by the controller; plain attribute stores are
+            # GIL-atomic for _next_cohort's reads, and responses never
+            # depend on either knob
+            self._adapt(len(cohort.reqs), max_wait, time.monotonic() - now)
+
+    def _adapt(self, occupancy: int, max_wait: float, service_s: float) -> None:
+        """Feed one flush's measurements to the adaptive controller —
+        honoring a RUNTIME planner flip: decisions read the gate per
+        call, so the controller must too.  Disabled mid-flight, the
+        knobs snap back to their static bases (the =0 contract is
+        'today's fixed values', not 'whatever the ramp left behind')."""
+        if self._adaptive is None:
+            return
+        from dgraph_tpu.query import planner as _planner
+
+        if _planner.enabled():
+            mb, fs = self._adaptive.update(occupancy, max_wait, service_s)
+        else:
+            mb, fs = self._adaptive.base_batch, self._adaptive.base_flush_s
+        self.max_batch, self.flush_s = mb, fs
 
     def _complete_follower(self, req, lead, merger) -> None:
         """Deal a singleflight leader's outcome to an attached twin."""

@@ -80,6 +80,25 @@ class DgraphServer:
         self.dumpsg_path = dumpsg_path
         self.cluster = cluster  # ClusterService when clustered, else None
         self.store = store
+        # planner calibration lifecycle (query/planner.py): a valid
+        # persisted calibration loads on every boot (warm boots skip the
+        # measurement pass); the micro-calibration itself runs only when
+        # DGRAPH_TPU_CALIBRATE=1 — a library/test construction must not
+        # pay a measurement pass it didn't ask for.  Priors serve until
+        # then, refined online from per-hop timings either way.
+        from dgraph_tpu.query import planner as _planner
+        from dgraph_tpu.utils import planconfig as _planconfig
+
+        if _planner.enabled():
+            try:
+                _planner.boot(measure_now=_planconfig.calibrate_at_boot())
+            except Exception as e:  # noqa: BLE001 — a wedged backend or
+                # unwritable scratch dir must degrade to priors, never
+                # refuse boot over a calibration nicety (counted, not
+                # silent)
+                from dgraph_tpu.utils.metrics import note_swallowed
+
+                note_swallowed("server.planner_boot", e)
         self.engine = QueryEngine(
             store,
             mesh=_auto_mesh(),
@@ -527,6 +546,21 @@ def _make_handler(srv: DgraphServer):
                 # process-wide
                 stats["join"] = joinplan.debug_summary()
                 self._reply(200, json.dumps(stats).encode())
+            elif path == "/debug/planner":
+                # the unified route-decision view (query/planner.py):
+                # calibration provenance + live rates, per-(kind,route)
+                # decision counts with mispredicts, the recent decision
+                # ring (each entry carries both cost estimates and — when
+                # the post-hoc check ran — the measured latency), PR 9's
+                # join ring, and the scheduler's adaptive cohort state
+                from dgraph_tpu.query import planner
+
+                self._reply(
+                    200,
+                    json.dumps(
+                        planner.debug_summary(scheduler=srv.scheduler)
+                    ).encode(),
+                )
             elif path in ("/metrics", "/debug/prometheus_metrics"):
                 # /metrics is the standard scrape alias; the debug path
                 # stays for existing scrape configs.  Content negotiation:

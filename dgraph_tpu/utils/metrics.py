@@ -478,6 +478,23 @@ TRACES_RECORDED = metrics.counter("dgraph_traces_recorded_total")
 SLOW_QUERIES = metrics.counter("dgraph_slow_queries_total")
 
 
+# measured-cost adaptive planner (query/planner.py): every route
+# decision is counted per (kind, route) — kind ∈ chain/expand/kway, the
+# join tier keeps its own dgraph_join_route_total below — and every
+# post-hoc check that catches the model on the wrong side of a
+# break-even lands in MISPREDICT{kind}.  Alert on the mispredict RATE
+# (mispredicts / decisions): a sustained rise means the persisted
+# calibration no longer matches the hardware — re-run the
+# micro-calibration pass (docs/deploy.md "Adaptive planner").
+PLANNER_DECISIONS = metrics.multilabeled(
+    "dgraph_planner_decisions_total", ("kind", "route")
+)
+PLANNER_MISPREDICTS = metrics.labeled(
+    "dgraph_planner_mispredict_total", label="kind"
+)
+PLANNER_CALIBRATIONS = metrics.counter("dgraph_planner_calibrations_total")
+
+
 # MXU join tier (ops/spgemm.py + query/joinplan.py): every per-query
 # route decision (mxu generic-join vs pairwise expansion) and every
 # size-gated k-way intersection's host-vs-device choice is counted, so

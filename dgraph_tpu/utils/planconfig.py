@@ -1,0 +1,200 @@
+"""Planner configuration: every execution-route gate knob in ONE module.
+
+Before PR 10 the engine's five execution routes (serial per-op, fused
+classed, chain-scan, fused recurse, MXU tile join) plus the host-vs-
+device k-way intersection were each gated by their own magic number,
+read from the environment at four different sites — two of them the
+SAME ``262144`` grown independently (``query/chain.py`` and
+``query/joinplan.py``).  This module is the deduplication: one table of
+documented defaults, one read path, and one override-detection helper
+(the adaptive planner in ``query/planner.py`` only substitutes its
+calibrated decision when the operator has NOT pinned the knob — an
+explicit env value or runtime assignment always wins).
+
+The graftlint rule ``naked-route-threshold`` (analysis/rules.py) forbids
+raw ``DGRAPH_TPU_*`` env reads and naked numeric route-gate comparisons
+in ``query/`` and ``ops/`` — new thresholds land HERE, with a docstring,
+or they don't land.
+
+Knob table (env name → default → what it gates):
+
+========================== ========= =====================================
+DGRAPH_TPU_PLANNER            "1"    measured-cost adaptive planner gate;
+                                     ``0`` restores every static threshold
+                                     below byte-identically
+DGRAPH_TPU_CHAIN_THRESHOLD  262144   min estimated chain fan-out before
+                                     fusing into one device program
+                                     (static fallback; the planner costs
+                                     the break-even instead)
+DGRAPH_TPU_EXPAND_DEVICE_MIN 262144  min per-level fan-out before an
+                                     expansion leaves host numpy for a
+                                     device dispatch (also gates cohort
+                                     hop merging)
+DGRAPH_TPU_KWAY_DEVICE_MIN  262144   min total candidate elements before
+                                     a k-way intersection rides one
+                                     batched device program
+DGRAPH_TPU_CHAIN_MAX_CAPC   1<<21    full-mode chain per-level overflow
+                                     chunk cap (transfer-sized)
+DGRAPH_TPU_CHAIN_MAX_CAPC_LIGHT
+                            1<<23    light-mode (var-block) chain cap
+                                     (HBM-sized; frontiers only on wire)
+DGRAPH_TPU_MXU_JOIN           "1"    MXU tile-join tier: 0 off / 1 cost-
+                                     modeled / force (skip cost compare)
+DGRAPH_TPU_MXU_MASK_MAX     1<<22    largest frontier-mask lane count the
+                                     mxu chain route may allocate
+DGRAPH_TPU_TILE               128    adjacency tile edge length (MXU-
+                                     native 128; tests shrink it)
+DGRAPH_TPU_TILE_BUDGET      1<<28    per-arena densified-tile byte budget
+DGRAPH_TPU_FUSED_HOP          "1"    classed-gather hop programs: 0 never
+                                     / 1 auto (cpu backend) / force
+DGRAPH_TPU_EXPAND_IMPL      "scan"   expand_csr owner-computation kernel
+                                     strategy (see ops/sets.py)
+DGRAPH_TPU_CLASS_W_MAX         10    widest classed-gather degree class
+                                     (log2); heavier rows take the dense
+                                     residual route (ops/batch.py)
+DGRAPH_TPU_CALIBRATION_FILE  scratch/planner_calib.json
+                                     persisted micro-calibration (warm
+                                     boots skip the measurement pass)
+DGRAPH_TPU_CALIBRATE          "0"    "1" re-measures at server boot and
+                                     re-persists (stale-calibration
+                                     remedy); default boots load the file
+========================== ========= =====================================
+
+Reads happen per call (not at import) so tests can flip knobs with
+monkeypatch and a long-lived process picks up operator edits on the
+next decision — EXCEPT the program-shape constants, which are bound
+once when their kernel module imports and are documented as such at
+the binding site: ``DGRAPH_TPU_CLASS_W_MAX`` (ops/batch.py LOG_W_MAX —
+the degree-class split is baked into every compiled hop program; a
+per-call read would churn the jit cache) and ``DGRAPH_TPU_EXPAND_IMPL``
+(ops/sets.py — same property, pre-existing behavior).  Set those in the
+environment before the first dgraph_tpu.ops import.
+"""
+
+from __future__ import annotations
+
+import os
+
+# -- documented defaults (the table above, machine-readable) -----------------
+
+CHAIN_THRESHOLD_DEFAULT = 262144
+EXPAND_DEVICE_MIN_DEFAULT = 262144
+KWAY_DEVICE_MIN_DEFAULT = 262144
+CHAIN_MAX_CAPC_DEFAULT = 1 << 21
+CHAIN_MAX_CAPC_LIGHT_DEFAULT = 1 << 23
+MXU_MASK_MAX_DEFAULT = 1 << 22
+TILE_DEFAULT = 128
+TILE_BUDGET_DEFAULT = 1 << 28
+CLASS_W_MAX_DEFAULT = 10
+CALIBRATION_FILE_DEFAULT = "scratch/planner_calib.json"
+
+
+def overridden(name: str) -> bool:
+    """Is this knob explicitly pinned in the environment?  The adaptive
+    planner treats a pinned knob as an operator override and falls back
+    to the static comparison for that gate."""
+    return name in os.environ
+
+
+def _int(name: str, default: int) -> int:
+    try:
+        return int(float(os.environ.get(name, default)))
+    except (ValueError, OverflowError):
+        # a typo'd ("lots") or absurd ("inf") knob falls back instead of
+        # crashing every decision that reads it
+        return default
+
+
+# -- gates -------------------------------------------------------------------
+
+
+def planner_enabled() -> bool:
+    """DGRAPH_TPU_PLANNER: the measured-cost planner gate (default ON).
+    ``0`` restores every static threshold byte-identically."""
+    return os.environ.get("DGRAPH_TPU_PLANNER", "1") != "0"
+
+
+def chain_threshold() -> int:
+    """Static min estimated fan-out before a chain fuses (the planner's
+    fallback; see module table)."""
+    return _int("DGRAPH_TPU_CHAIN_THRESHOLD", CHAIN_THRESHOLD_DEFAULT)
+
+
+def expand_device_min() -> int:
+    """Static min per-level fan-out before host numpy yields to a device
+    dispatch (shared by the engine, the resolver and merge gating)."""
+    return _int("DGRAPH_TPU_EXPAND_DEVICE_MIN", EXPAND_DEVICE_MIN_DEFAULT)
+
+
+def kway_device_min() -> int:
+    """Static min total candidate elements before a k-way intersection
+    takes the batched device program over the host fold."""
+    return _int("DGRAPH_TPU_KWAY_DEVICE_MIN", KWAY_DEVICE_MIN_DEFAULT)
+
+
+def chain_max_capc() -> int:
+    """Full-mode chain per-level overflow-chunk cap (transfer-sized)."""
+    return _int("DGRAPH_TPU_CHAIN_MAX_CAPC", CHAIN_MAX_CAPC_DEFAULT)
+
+
+def chain_max_capc_light() -> int:
+    """Light-mode (var-block) chain cap — device-resident matrices can
+    afford much larger buffers than transferring ones."""
+    return _int(
+        "DGRAPH_TPU_CHAIN_MAX_CAPC_LIGHT", CHAIN_MAX_CAPC_LIGHT_DEFAULT
+    )
+
+
+def mxu_mode() -> str:
+    """DGRAPH_TPU_MXU_JOIN: '0' off, '1' cost-modeled (default), 'force'
+    always (structural eligibility permitting)."""
+    return os.environ.get("DGRAPH_TPU_MXU_JOIN", "1")
+
+
+def mask_max_lanes() -> int:
+    """Largest frontier-mask length the mxu chain route may allocate
+    (float32 lanes; the default 1<<22 ≈ 16MB per mask)."""
+    return _int("DGRAPH_TPU_MXU_MASK_MAX", MXU_MASK_MAX_DEFAULT)
+
+
+def tile_size() -> int:
+    """Adjacency tile edge length; 128 is MXU-native."""
+    return _int("DGRAPH_TPU_TILE", TILE_DEFAULT)
+
+
+def tile_budget() -> int:
+    """Per-arena densified-tile byte budget."""
+    return _int("DGRAPH_TPU_TILE_BUDGET", TILE_BUDGET_DEFAULT)
+
+
+def fused_hop() -> str:
+    """DGRAPH_TPU_FUSED_HOP: classed-gather hop gate ('0'/'1'/'force')."""
+    return os.environ.get("DGRAPH_TPU_FUSED_HOP", "1")
+
+
+def expand_impl() -> str:
+    """expand_csr owner-computation strategy (ops/sets.py)."""
+    return os.environ.get("DGRAPH_TPU_EXPAND_IMPL", "scan")
+
+
+def class_w_max() -> int:
+    """Widest classed-gather degree class (log2 width); rows above it
+    route to the dense residual bucket."""
+    return _int("DGRAPH_TPU_CLASS_W_MAX", CLASS_W_MAX_DEFAULT)
+
+
+def calibration_file() -> str:
+    """Path of the persisted micro-calibration JSON ('' disables
+    persistence entirely)."""
+    return os.environ.get(
+        "DGRAPH_TPU_CALIBRATION_FILE", CALIBRATION_FILE_DEFAULT
+    )
+
+
+def calibrate_at_boot() -> bool:
+    """DGRAPH_TPU_CALIBRATE=1: RE-run the micro-calibration pass at
+    server boot and persist it, replacing any existing file — the
+    stale-calibration remedy.  Default off: ordinary boots load the
+    persisted file (warm path) or serve from priors; library and test
+    constructions never pay a measurement pass."""
+    return os.environ.get("DGRAPH_TPU_CALIBRATE", "0") == "1"

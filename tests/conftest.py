@@ -18,6 +18,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# hermetic planner calibration: a bench round persists measured rates to
+# scratch/planner_calib.json, and server boots load it — tier-1 results
+# must not depend on whether a bench ran on this checkout first.  Tests
+# that exercise the file lifecycle point this at a tmp_path explicitly.
+os.environ.setdefault("DGRAPH_TPU_CALIBRATION_FILE", "")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

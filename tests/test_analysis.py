@@ -19,6 +19,7 @@ from dgraph_tpu.analysis.rules import (
     HostSyncInJit,
     NakedAtomicWrite,
     NakedPeerRpc,
+    NakedRouteThreshold,
     NakedStageTiming,
     RecompileHazard,
     SwallowedException,
@@ -468,6 +469,96 @@ def test_naked_stage_timing_pragma_with_why():
     """)
     assert check_source(
         src, [NakedStageTiming()], path="dgraph_tpu/query/profiler.py"
+    ) == []
+
+
+def test_naked_route_threshold_env_read_flagged():
+    # the PR-10 origin story: a DGRAPH_TPU_* env read growing a new magic
+    # threshold inside the routing layers
+    src = textwrap.dedent("""
+        import os
+
+        def gate():
+            return int(os.environ.get("DGRAPH_TPU_NEW_ROUTE_MIN", 262144))
+    """)
+    assert _ids(
+        check_source(
+            src, [NakedRouteThreshold()], path="dgraph_tpu/query/newroute.py"
+        )
+    ) == ["naked-route-threshold"]
+    # os.getenv spelling too, and ops/ is in scope
+    src2 = textwrap.dedent("""
+        import os
+
+        def gate():
+            return os.getenv("DGRAPH_TPU_KERNEL_PICK", "auto")
+    """)
+    assert _ids(
+        check_source(
+            src2, [NakedRouteThreshold()], path="dgraph_tpu/ops/newkernel.py"
+        )
+    ) == ["naked-route-threshold"]
+
+
+def test_naked_route_threshold_literal_compare_flagged():
+    # both historical spellings: the bare decimal and the shifted literal
+    src = textwrap.dedent("""
+        def pick(est_total, capc):
+            if est_total < 262144:
+                return "host"
+            if capc > 1 << 21:
+                return "abort"
+            return "device"
+    """)
+    findings = check_source(
+        src, [NakedRouteThreshold()], path="dgraph_tpu/query/route.py"
+    )
+    assert _ids(findings) == ["naked-route-threshold"] * 2
+
+
+def test_naked_route_threshold_counterexamples_clean():
+    # named thresholds from planconfig / the planner are the fix
+    routed = textwrap.dedent("""
+        from dgraph_tpu.utils import planconfig
+
+        def pick(est_total):
+            if est_total < planconfig.chain_threshold():
+                return "host"
+            return "device"
+    """)
+    assert check_source(
+        routed, [NakedRouteThreshold()], path="dgraph_tpu/query/route.py"
+    ) == []
+    # small literals (capacities, buckets, lane widths) are not gates
+    small = textwrap.dedent("""
+        def bucketed(n):
+            if n < 4096:
+                return 4096
+            return n
+    """)
+    assert check_source(
+        small, [NakedRouteThreshold()], path="dgraph_tpu/ops/kern.py"
+    ) == []
+    # outside query//ops/ the rule does not apply (models/ owns its own
+    # budgets; serve/ reads its knobs through its gates)
+    outside = textwrap.dedent("""
+        import os
+
+        def budget():
+            return int(os.environ.get("DGRAPH_TPU_ARENA_BUDGET", 262144))
+    """)
+    assert check_source(
+        outside, [NakedRouteThreshold()], path="dgraph_tpu/models/arena.py"
+    ) == []
+    # the pragma escape hatch carries the WHY
+    pragmad = textwrap.dedent("""
+        def sanity(cap):
+            # jit-cache hard stop, not a route gate
+            # graftlint: ignore[naked-route-threshold]
+            assert cap < 16777216
+    """)
+    assert check_source(
+        pragmad, [NakedRouteThreshold()], path="dgraph_tpu/ops/kern.py"
     ) == []
 
 
