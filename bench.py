@@ -640,6 +640,264 @@ def run_serving_bench():
     }
 
 
+def _qos_mode(
+    qos_on: bool,
+    store,
+    victim_qs,
+    antag_qs,
+    v_clients: int,
+    a_clients: int,
+    secs: float,
+    tenants_json: str,
+):
+    """One closed-loop antagonist/victim run: ``v_clients`` victim
+    threads fire light point reads under tenant ``victim`` while
+    ``a_clients`` antagonist threads flood heavy traversals under
+    tenant ``antagonist``.  ``qos_on`` flips DGRAPH_TPU_QOS — the PR-11
+    A/B.  Cache is OFF for both arms (an antagonist whose repeats hit
+    the result cache would stress nothing).  Antagonist 429s (quota
+    sheds) are counted, not errors — being shed IS the mechanism under
+    test.  Returns (victim qps, p50_ms, p99_ms, antag_ok, antag_shed)."""
+    import json as _json
+    import threading
+
+    # save/restore EVERYTHING this arm pins: a later arm (or the
+    # operator's own exports) must not inherit this arm's regime
+    saved = {
+        k: os.environ.get(k)
+        for k in ("DGRAPH_TPU_SCHED", "DGRAPH_TPU_CACHE",
+                  "DGRAPH_TPU_QOS", "DGRAPH_TPU_QOS_TENANTS")
+    }
+    os.environ["DGRAPH_TPU_SCHED"] = "1"
+    os.environ["DGRAPH_TPU_CACHE"] = "0"
+    os.environ["DGRAPH_TPU_QOS"] = "1" if qos_on else "0"
+    os.environ["DGRAPH_TPU_QOS_TENANTS"] = tenants_json
+    from dgraph_tpu.serve.server import DgraphServer
+
+    srv = DgraphServer(store)
+    srv.start()
+    try:
+        import http.client
+
+        def post_on(conn, q, tenant):
+            conn.request(
+                "POST", "/query", body=q.encode(),
+                headers={"X-Dgraph-Tenant": tenant},
+            )
+            r = conn.getresponse()
+            body = r.read()
+            return r.status, body
+
+        warm = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+        for q in (victim_qs + antag_qs)[:4]:  # compile warmup, untimed
+            post_on(warm, q, "warmup")
+        warm.close()
+
+        lock = threading.Lock()
+        v_lats: list = []
+        a_ok = [0]
+        a_shed = [0]
+        errs: list = []
+        stop_at = [0.0]
+
+        def victim(cid: int):
+            rng = np.random.default_rng(100 + cid)
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+            my = []
+            try:
+                while time.monotonic() < stop_at[0]:
+                    q = victim_qs[int(rng.integers(len(victim_qs)))]
+                    t0 = time.monotonic()
+                    status, body = post_on(conn, q, "victim")
+                    if status != 200:
+                        raise RuntimeError(
+                            f"victim HTTP {status}: {body[:120]!r}"
+                        )
+                    _json.loads(body.decode())
+                    my.append(time.monotonic() - t0)
+            except Exception as e:
+                errs.append(e)
+            finally:
+                conn.close()
+            with lock:
+                v_lats.extend(my)
+
+        def antagonist(cid: int):
+            rng = np.random.default_rng(900 + cid)
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+            ok = shed = 0
+            try:
+                while time.monotonic() < stop_at[0]:
+                    q = antag_qs[int(rng.integers(len(antag_qs)))]
+                    try:
+                        status, _body = post_on(conn, q, "antagonist")
+                    except OSError:
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", srv.port, timeout=60
+                        )
+                        continue
+                    if status == 200:
+                        ok += 1
+                    elif status == 429:
+                        shed += 1
+                        # honor back-pressure minimally: a real client
+                        # would sleep Retry-After; the flood sleeps just
+                        # enough not to busy-spin the accept loop
+                        time.sleep(0.002)
+                    else:
+                        raise RuntimeError(f"antagonist HTTP {status}")
+            except Exception as e:
+                errs.append(e)
+            finally:
+                conn.close()
+            with lock:
+                a_ok[0] += ok
+                a_shed[0] += shed
+
+        ts = [
+            threading.Thread(target=victim, args=(c,), daemon=True)
+            for c in range(v_clients)
+        ] + [
+            threading.Thread(target=antagonist, args=(c,), daemon=True)
+            for c in range(a_clients)
+        ]
+        stop_at[0] = time.monotonic() + secs
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=secs + 120)
+        wall = time.monotonic() - t0
+        if errs:
+            raise errs[0]
+        if not v_lats:
+            raise RuntimeError("qos bench victim made no requests")
+        a = np.sort(np.asarray(v_lats))
+        return (
+            len(a) / wall,
+            float(a[int(0.50 * (len(a) - 1))]) * 1e3,
+            float(a[int(0.99 * (len(a) - 1))]) * 1e3,
+            a_ok[0],
+            a_shed[0],
+        )
+    finally:
+        srv.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_qos_bench():
+    """Antagonist-isolation benchmark (PR 11's headline robustness
+    number).  Three arms over one store:
+
+    - ``victim_solo`` — victim tenant alone, QoS on: the baseline SLO.
+    - ``qos_on``      — victim + antagonist flood, QoS on: the
+      antagonist is quota-shed (max_queued) and weight-limited, and the
+      victim's p99 must stay within ``BENCH_QOS_FACTOR`` (default 3×)
+      of its solo p99 — asserted, not just reported.
+    - ``qos_off``     — the SAME mix with DGRAPH_TPU_QOS=0: shows the
+      leak (victim p99 blowup with no per-tenant machinery).
+
+    Sized by BENCH_QOS_NODES/DEG/SECONDS/VICTIM_CLIENTS/ANTAG_CLIENTS;
+    BENCH_QOS_ASSERT=0 downgrades the assertion to reporting (the CI
+    smoke keeps it on with a generous factor — a 2-core shared runner
+    proves the harness, not the SLO)."""
+    n_nodes = int(os.environ.get("BENCH_QOS_NODES", 20_000))
+    deg = int(os.environ.get("BENCH_QOS_DEG", 16))
+    secs = float(os.environ.get("BENCH_QOS_SECONDS", 3.0))
+    v_clients = int(os.environ.get("BENCH_QOS_VICTIM_CLIENTS", 4))
+    a_clients = int(os.environ.get("BENCH_QOS_ANTAG_CLIENTS", 16))
+    factor = float(os.environ.get("BENCH_QOS_FACTOR", 3.0))
+    do_assert = os.environ.get("BENCH_QOS_ASSERT", "1") != "0"
+    store = _serving_store(n_nodes, deg)
+
+    rng = np.random.default_rng(17)
+    # victim: single-uid point reads with a count leaf — the 1ms-class
+    # traffic whose SLO the antagonist must not wreck
+    victim_qs = [
+        "{ q(func: uid(0x%x)) { c: count(e) } }" % u
+        for u in np.unique(rng.integers(1, n_nodes + 1, size=64))
+    ]
+    # antagonist: wide 2-hop expansions from 64-seed lists — each one
+    # orders of magnitude more engine work than a victim read
+    antag_qs = []
+    for _ in range(128):
+        seeds = np.unique(rng.integers(1, n_nodes + 1, size=64))
+        ul = ", ".join("0x%x" % u for u in seeds)
+        antag_qs.append("{ q(func: uid(%s)) { e { e { c: count(e) } } } }" % ul)
+
+    # the QoS envelope under test: the victim outweighs the antagonist
+    # 8:1 for cohort slots, and the antagonist's own queue/inflight
+    # quota sheds its flood at admission instead of letting it occupy
+    # the global queue
+    tenants = json.dumps({
+        "victim": {"weight": 8, "priority": "interactive"},
+        "antagonist": {
+            "weight": 1, "max_queued": 8, "max_inflight": 1,
+            "priority": "batch",
+        },
+    })
+
+    solo_qps, solo_p50, solo_p99, _ok, _shed = _qos_mode(
+        True, store, victim_qs, antag_qs, v_clients, 0, secs, tenants
+    )
+    on_qps, on_p50, on_p99, on_ok, on_shed = _qos_mode(
+        True, store, victim_qs, antag_qs, v_clients, a_clients, secs, tenants
+    )
+    off_qps, off_p50, off_p99, off_ok, off_shed = _qos_mode(
+        False, store, victim_qs, antag_qs, v_clients, a_clients, secs, tenants
+    )
+    # floor: on a noisy shared host a 0.3ms solo p99 would make any
+    # ratio meaningless — compare against at least a 5ms baseline
+    base = max(solo_p99, 5.0)
+    isolation = on_p99 / base
+    leak = off_p99 / base
+    out = {
+        "seconds": secs,
+        "victim_clients": v_clients,
+        "antagonist_clients": a_clients,
+        "tenants": json.loads(tenants),
+        "victim_solo": {
+            "qps": round(solo_qps, 1), "p50_ms": round(solo_p50, 2),
+            "p99_ms": round(solo_p99, 2),
+        },
+        "qos_on": {
+            "victim_qps": round(on_qps, 1),
+            "victim_p50_ms": round(on_p50, 2),
+            "victim_p99_ms": round(on_p99, 2),
+            "antagonist_ok": on_ok,
+            "antagonist_shed": on_shed,
+        },
+        "qos_off": {
+            "victim_qps": round(off_qps, 1),
+            "victim_p50_ms": round(off_p50, 2),
+            "victim_p99_ms": round(off_p99, 2),
+            "antagonist_ok": off_ok,
+            "antagonist_shed": off_shed,
+        },
+        # the headline pair: bounded with QoS on, the leak without
+        "victim_p99_factor_qos_on": round(isolation, 3),
+        "victim_p99_factor_qos_off": round(leak, 3),
+        "bound_factor": factor,
+        "isolation_holds": bool(isolation <= factor),
+    }
+    if do_assert:
+        assert on_shed > 0, (
+            "qos bench: the antagonist was never quota-shed — the "
+            "per-tenant admission quota did not engage"
+        )
+        assert isolation <= factor, (
+            f"qos bench: victim p99 under antagonist flood "
+            f"({on_p99:.1f}ms) exceeded {factor}x its solo baseline "
+            f"({solo_p99:.1f}ms, floored to {base:.1f}ms)"
+        )
+    return out
+
+
 def _mutation_mode(
     group_commit: bool, clients: int, secs: float, tmp: str,
     fsync_ms: float = 0.0,
@@ -922,6 +1180,15 @@ def run_bench(scale: float):
             durability = run_mutation_bench()
         except Exception as e:
             durability = {"error": f"{type(e).__name__}: {e}"}
+    qos_arm = None
+    if os.environ.get("BENCH_QOS", "1") != "0":
+        # antagonist/victim isolation A/B (PR 11); same isolation
+        # contract — a failed assertion lands in the JSON, the headline
+        # traversal number survives
+        try:
+            qos_arm = run_qos_bench()
+        except Exception as e:
+            qos_arm = {"error": f"{type(e).__name__}: {e}"}
     # planner honesty row: every route decision this process made (the
     # serving arms run in-process) with the measured mispredict rate —
     # future bench rounds show route choice alongside throughput, and a
@@ -950,6 +1217,10 @@ def run_bench(scale: float):
                 # durable-mutation A/B (BENCH_MUT=0 skips;
                 # BENCH_MUT_CLIENTS / BENCH_MUT_SECONDS size it)
                 "durability": durability,
+                # antagonist/victim multi-tenant QoS A/B (BENCH_QOS=0
+                # skips; BENCH_QOS_* size it) — victim p99 bounded with
+                # QoS on, the leak shown with QoS off
+                "qos": qos_arm,
                 # measured-cost planner (PR 10): per-route decision
                 # counts + mispredict rate + the calibrated rates that
                 # drove this run's routing
@@ -978,6 +1249,12 @@ def run_bench(scale: float):
 def main():
     platform = ensure_backend()
     print(f"# backend: {platform}", file=sys.stderr)
+    if os.environ.get("BENCH_ONLY") == "qos":
+        # standalone qos smoke (CI): the antagonist/victim harness runs
+        # without paying for the headline traversal bench — the job
+        # exists so the harness itself cannot rot
+        print(json.dumps({"qos": run_qos_bench(), "platform": platform}))
+        return
     scale = float(os.environ.get("BENCH_SCALE", 1.0))
     try:
         run_bench(scale)

@@ -48,6 +48,15 @@ on a noisy 2-core CPU host:
   3-hop queries it wins (BENCH21M).  Every gate lives in
   ``utils/planconfig.py`` with a documented default, and the decision
   itself belongs to the calibrated planner (``query/planner.py``).
+- ``unchecked-hop-loop``: a loop in ``query/`` that drives the
+  expander/dispatch seam (``expand``/``submit_hop``/``_expand_rows``/
+  ``_exec_child``/``multi_hop``) without a ``CancelToken`` checkpoint —
+  cooperative cancellation (PR 11, sched/qos.py) only works if EVERY
+  hop-dispatching loop checkpoints; one unchecked loop and a
+  deadline-expired or disconnected query silently runs to completion
+  again.  Call ``engine.checkpoint()`` / ``resolver.checkpoint()`` (or
+  ``<token>.check()``) inside the loop, or pragma the site with the
+  WHY.
 
 Suppress a deliberate site with ``# graftlint: ignore[rule-id]`` on the
 line (or the line above).  docs/analysis.md has the full catalog and
@@ -789,6 +798,83 @@ class NakedRouteThreshold(Rule):
                         break
 
 
+# -- rule: unchecked-hop-loop -----------------------------------------------
+
+# the expander/dispatch seam: calls that (directly or one wrapper deep)
+# cost a hop dispatch per iteration.  ``expand`` as a BARE name covers
+# the local-closure shape (query/shortest.py's lazy expander); the rest
+# are the engine/resolver method names.
+_HOP_SEAM_ATTRS = {
+    "expand", "_expand", "_expand_rows", "_exec_child",
+    "_exec_child_inner", "submit_hop", "multi_hop",
+}
+_HOP_CHECK_ATTRS = {"checkpoint"}
+
+
+def _is_seam_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr in _HOP_SEAM_ATTRS
+    return isinstance(f, ast.Name) and f.id == "expand"
+
+
+def _is_checkpoint_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in _HOP_CHECK_ATTRS:
+            return True
+        # direct token probe: <something>cancel/token<something>.check()
+        if f.attr == "check":
+            root = _dotted(f).lower()
+            return "cancel" in root or "token" in root
+    return isinstance(f, ast.Name) and f.id in _HOP_CHECK_ATTRS
+
+
+class UncheckedHopLoop(Rule):
+    id = "unchecked-hop-loop"
+    doc = (
+        "loop in query/ driving the expander/dispatch seam without a "
+        "CancelToken checkpoint — cooperative cancellation needs a "
+        "checkpoint in EVERY hop-dispatching loop (engine.checkpoint() "
+        "/ resolver.checkpoint() / <token>.check())"
+    )
+
+    # query/ is the layer that drives hop dispatches in loops; ops/
+    # loops run INSIDE jitted programs where a checkpoint is impossible
+    # by design (the documented cancellation granularity is one
+    # dispatched program), and sched/ owns the token itself.
+    _DIRS = ("query/",)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if not any(d in path for d in self._DIRS):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            has_seam = False
+            has_check = False
+            for sub in ast.walk(node):
+                if _is_seam_call(sub):
+                    has_seam = True
+                elif _is_checkpoint_call(sub):
+                    has_check = True
+            if has_seam and not has_check:
+                yield ctx.finding(
+                    self.id, node,
+                    "this loop dispatches hop expansions but never "
+                    "checkpoints the request's CancelToken: a "
+                    "deadline-expired or disconnected query keeps "
+                    "burning engine time here — call engine.checkpoint()"
+                    " (or resolver.checkpoint() / <token>.check()) "
+                    "inside the loop, or pragma the site with the WHY",
+                )
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     HostSyncInJit(),
     RecompileHazard(),
@@ -798,4 +884,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     NakedAtomicWrite(),
     NakedStageTiming(),
     NakedRouteThreshold(),
+    UncheckedHopLoop(),
 )

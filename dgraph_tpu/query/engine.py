@@ -31,6 +31,7 @@ from dgraph_tpu.query.functions import FuncResolver, QueryError
 from dgraph_tpu.query.subgraph import SubGraph, build_subgraph
 from dgraph_tpu.query import outputnode, planner
 from dgraph_tpu.utils import planconfig
+from dgraph_tpu.utils.failpoints import fail
 
 _EMPTY = np.empty(0, dtype=np.int64)
 
@@ -103,6 +104,9 @@ def _fresh_stats() -> dict:
         "device_order_ms": 0.0,
         "tile_build_ms": 0.0,
         "mxu_join_ms": 0.0,
+        # root-level `first: k` early termination (sched/qos.py gate):
+        # number of root filters that stopped after enough survivors
+        "first_early_exit": 0,
     }
 
 
@@ -172,7 +176,15 @@ class DeviceExpander:
         expansion took (cache/merged/mesh/host/classed/inline/csr; the
         chain-level ``mxu`` route emits its own hop span upstream) and
         the device-time split; the unsampled path branches away before
-        any span object exists."""
+        any span object exists.
+
+        This call IS the hop-dispatch boundary: the cooperative
+        CancelToken (sched/qos.py) is checkpointed here — a cancelled,
+        deadline-lapsed or disconnected request stops BEFORE its next
+        dispatch, never inside a jitted program — and the ``engine.hop``
+        failpoint lets chaos tests stretch exactly this seam."""
+        self.engine.checkpoint()
+        fail.point("engine.hop")
         sp = obs.current_span()
         if sp is None:  # unsampled hot path: zero allocations, async dispatch
             return self._expand_cached(arena, src, attr, reverse)
@@ -462,6 +474,23 @@ class QueryEngine:
         # payloads on a long-lived engine) in last_dump, reset per request
         self.dump_shapes = False
         self.last_dump = None
+        # cooperative cancellation (sched/qos.py): the scheduler installs
+        # the request's CancelToken here; checkpoint() probes it at
+        # hop-dispatch boundaries.  None (embedded engines, QoS off)
+        # costs one attribute read per checkpoint.
+        self.cancel = None
+
+    def checkpoint(self) -> None:
+        """Cooperative cancellation checkpoint: raises
+        QueryCancelledError when this request's token flipped (deadline
+        lapse, client disconnect, /admin/cancel).  Placed at
+        hop-dispatch boundaries only — a dispatched device program
+        always completes, so cancellation latency is bounded by one
+        hop.  The graftlint rule ``unchecked-hop-loop`` enforces a
+        checkpoint in every query/ loop that drives the expander."""
+        tok = self.cancel
+        if tok is not None:
+            tok.check()
 
     @property
     def expand_device_min(self) -> int:
@@ -569,7 +598,8 @@ class QueryEngine:
 
     def _exec_block(self, sg: SubGraph, uid_vars, value_vars):
         resolver = FuncResolver(
-            self.store, self.arenas, uid_vars, value_vars, stats=self.stats
+            self.store, self.arenas, uid_vars, value_vars, stats=self.stats,
+            cancel=self.cancel,
         )
         # var blocks are never encoded → chains under them may skip result
         # matrices entirely (light mode, query/chain.py)
@@ -582,7 +612,7 @@ class QueryEngine:
             return
         dest = self._root_uids(sg, resolver)
         if sg.filter is not None:
-            dest = self._apply_filter(sg.filter, dest, resolver)
+            dest = self._apply_root_filter(sg, dest, resolver)
         dest = self._order_and_paginate_root(sg, dest, value_vars)
         sg.dest_uids = dest
         if sg.params.is_groupby:
@@ -615,6 +645,7 @@ class QueryEngine:
         src = sg.dest_uids
         self._expand_expand_nodes(sg, value_vars)
         for child in sg.children:
+            self.checkpoint()
             self._exec_child(child, src, resolver, uid_vars, value_vars)
         if sg.params.cascade and sg.children:
             self._cascade_prune(sg)
@@ -898,6 +929,62 @@ class QueryEngine:
         return self.expander.expand(arena, src, attr=attr, reverse=reverse)
 
     # -- filters -----------------------------------------------------------
+
+    def _apply_root_filter(
+        self, sg: SubGraph, dest: np.ndarray, resolver
+    ) -> np.ndarray:
+        """Root filter application with `first: k` early termination
+        (the QoS PR's early-exit leg): when the block carries a positive
+        ``first`` and no ordering, the final dest is the first
+        ``offset+first`` (post-``after``) survivors in uid order — so
+        the filter evaluates over ASCENDING CHUNKS of the candidate set
+        and stops once enough survive, instead of paying per-candidate
+        filter work (and, downstream, chain-scan / per-level expansion
+        sizing) proportional to the whole candidate universe.
+
+        Byte-identical by construction: filters are per-candidate
+        membership tests (and/or/not over uid sets), so filtering
+        commutes with chunking, chunks are consumed in ascending uid
+        order, and the accumulated prefix feeds the SAME
+        _order_and_paginate_root windowing.  Ineligible shapes (order,
+        negative windows, unsorted candidates) and DGRAPH_TPU_QOS=0
+        take the legacy whole-set path unchanged."""
+        p = sg.params
+        need = (p.first or 0) + max(p.offset or 0, 0)
+        from dgraph_tpu.sched.qos import qos_enabled
+
+        if (
+            (p.first or 0) <= 0
+            or p.order_attr
+            or (p.offset or 0) < 0
+            or not qos_enabled()
+        ):
+            return self._apply_filter(sg.filter, dest, resolver)
+        # chunk floor: global filter leaves (index funcs) re-resolve per
+        # chunk, so start big enough that doubling reaches the whole set
+        # in a few rounds — the early exit must never turn one filter
+        # pass into O(n/k) of them
+        chunk = max(1024, 8 * need)
+        if len(dest) <= chunk or not bool(np.all(dest[1:] > dest[:-1])):
+            return self._apply_filter(sg.filter, dest, resolver)
+        after = p.after or 0
+        parts: List[np.ndarray] = []
+        got = 0
+        pos = 0
+        while pos < len(dest):
+            self.checkpoint()
+            part = self._apply_filter(
+                sg.filter, dest[pos : pos + chunk], resolver
+            )
+            parts.append(part)
+            got += int((part > after).sum()) if after else len(part)
+            pos += chunk
+            if got >= need:
+                if pos < len(dest):
+                    self.stats["first_early_exit"] += 1
+                break
+            chunk *= 2
+        return np.concatenate(parts)
 
     def _apply_filter(self, ft: FilterTree, candidates: np.ndarray, resolver) -> np.ndarray:
         if ft.func is not None:

@@ -49,6 +49,7 @@ class FuncResolver:
         uid_vars: Dict[str, np.ndarray],
         value_vars: Dict[str, Dict[int, TypedValue]],
         stats: Optional[dict] = None,
+        cancel=None,
     ):
         self.store = store
         self.arenas = arenas
@@ -58,6 +59,16 @@ class FuncResolver:
         # k-way intersection router counts its host-vs-device choices
         # here so debug=true responses agree with the process counters
         self.stats = stats
+        # cooperative cancellation (sched/qos.py): index probes that
+        # loop over per-token/cell expansions checkpoint this token
+        self.cancel = cancel
+
+    def checkpoint(self) -> None:
+        """Cancellation checkpoint for resolver-side expansion loops
+        (the graftlint ``unchecked-hop-loop`` contract)."""
+        tok = self.cancel
+        if tok is not None:
+            tok.check()
 
     # -- public ------------------------------------------------------------
 
@@ -309,6 +320,7 @@ class FuncResolver:
             return _EMPTY
         sets = []
         for t in qtoks:
+            self.checkpoint()
             r = idx.row_of(t)
             if r < 0:
                 if all_of:
@@ -360,6 +372,7 @@ class FuncResolver:
             tsets = []
             for lit in _literal_runs(pat):
                 for tg in tokmod.trigram_tokens(lit):
+                    self.checkpoint()
                     r = idx.row_of(tg)
                     tsets.append(
                         self._expand_rows(idx.csr, np.array([r]))
@@ -445,6 +458,7 @@ class FuncResolver:
         cand = None
         sets = []
         for c in cells:
+            self.checkpoint()
             r = idx.row_of(c)
             if r >= 0:
                 sets.append(self._expand_rows(idx.csr, np.array([r])))

@@ -48,6 +48,9 @@ def recurse(engine, sg: SubGraph, resolver):
             if not len(src):
                 continue
             for tmpl in uid_templates:
+                # cancellation checkpoint per realized level-template:
+                # a cancelled @recurse stops before its next expansion
+                engine.checkpoint()
                 child = SubGraph(
                     attr=tmpl.attr,
                     alias=tmpl.alias,
@@ -68,6 +71,7 @@ def recurse(engine, sg: SubGraph, resolver):
                 child.dest_uids = np.unique(child.out_flat)
                 # re-fetch value leaves for the new frontier
                 for vc in child.children:
+                    engine.checkpoint()
                     engine._exec_child(vc, child.dest_uids, resolver, {}, {})
                 edges += len(child.out_flat)
                 parent.children = parent.children + [child]
@@ -86,6 +90,7 @@ def recurse(engine, sg: SubGraph, resolver):
     sg.children = [c for c in sg.children if c not in uid_templates]
     # root-level value leaves for the root frontier
     for vc in sg.children:
+        engine.checkpoint()
         if not _is_uid_child(engine, vc) and not vc.values:
             engine._exec_child(vc, sg.dest_uids, resolver, {}, {})
 

@@ -44,6 +44,10 @@ def shortest_path(engine, sg: SubGraph, resolver):
             adj.setdefault(int(u), [])
             expanded.add(int(u))
         for tmpl in preds:
+            # cancellation checkpoint per predicate expansion: Dijkstra
+            # over a big fan-out must stop at the next hop, not at the
+            # end of the search
+            engine.checkpoint()
             child = SubGraph(attr=tmpl.attr, params=tmpl.params, filter=tmpl.filter,
                              reverse=tmpl.reverse)
             engine._exec_child(child, np.sort(todo), resolver, {}, {})
@@ -69,6 +73,7 @@ def shortest_path(engine, sg: SubGraph, resolver):
     heap: List[Tuple[float, int, List[int]]] = [(0.0, src, [src])]
     best_count: Dict[int, int] = {}
     while heap and len(found) < k and edges < MAX_EDGES:
+        engine.checkpoint()
         cost, u, path = heapq.heappop(heap)
         if best_count.get(u, 0) >= k:
             continue

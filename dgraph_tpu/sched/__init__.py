@@ -1,23 +1,35 @@
 """Cohort scheduler: continuous micro-batching of concurrent read
-queries onto the fused device executor (see scheduler.py / cohort.py)."""
+queries onto the fused device executor (see scheduler.py / cohort.py),
+with multi-tenant QoS — per-tenant quotas, weighted-fair cohort pick,
+and cooperative cancellation (qos.py)."""
 
 from dgraph_tpu.sched.cohort import (
     Cohort,
     HopMerger,
     SchedDeadlineError,
     SchedOverloadError,
+    SchedQuotaError,
     SchedRequest,
     hop_signature,
+)
+from dgraph_tpu.sched.qos import (
+    CancelToken,
+    QueryCancelledError,
+    qos_enabled,
 )
 from dgraph_tpu.sched.scheduler import CohortScheduler, sched_enabled
 
 __all__ = [
+    "CancelToken",
     "Cohort",
     "CohortScheduler",
     "HopMerger",
+    "QueryCancelledError",
     "SchedDeadlineError",
     "SchedOverloadError",
+    "SchedQuotaError",
     "SchedRequest",
     "hop_signature",
+    "qos_enabled",
     "sched_enabled",
 ]

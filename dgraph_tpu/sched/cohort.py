@@ -44,6 +44,19 @@ class SchedOverloadError(RuntimeError):
     """Admission queue over capacity: shed (HTTP 429 / RESOURCE_EXHAUSTED)."""
 
 
+class SchedQuotaError(SchedOverloadError):
+    """Per-TENANT admission quota exceeded (sched/qos.py): shed before
+    the global cap, with a tenant-scoped Retry-After — the tenant's own
+    backlog sizes the hint, not the server-wide queue.  Subclasses
+    SchedOverloadError so every existing 429/RESOURCE_EXHAUSTED mapping
+    keeps working; handlers that know about QoS add the header."""
+
+    def __init__(self, msg: str, tenant: str, retry_after: float):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.retry_after = retry_after
+
+
 class SchedDeadlineError(RuntimeError):
     """Request budget expired while queued (HTTP 504 / DEADLINE_EXCEEDED)."""
 
@@ -112,15 +125,22 @@ class SchedRequest:
     __slots__ = (
         "parsed", "debug", "deadline", "enqueued", "key",
         "_done", "result", "stats", "error", "span", "queue_span",
+        "tenant", "cancel",
     )
 
     def __init__(self, parsed, debug: bool = False,
-                 deadline: Optional[float] = None, key=None):
+                 deadline: Optional[float] = None, key=None,
+                 tenant: str = "", cancel=None):
         self.parsed = parsed
         self.debug = debug
         self.deadline = deadline          # absolute time.monotonic(), or None
         self.enqueued = time.monotonic()
         self.key = key                    # None = never coalesce
+        # multi-tenant QoS (sched/qos.py): the admission scope ("" when
+        # QoS is off — then neither field is ever read) and the
+        # cooperative CancelToken the engine checkpoints against
+        self.tenant = tenant
+        self.cancel = cancel
         self._done = threading.Event()
         self.result: Optional[dict] = None
         self.stats: Optional[dict] = None
@@ -168,14 +188,17 @@ class SchedRequest:
 
 
 class Cohort:
-    """Requests sharing one hop-program signature, awaiting a flush."""
+    """Requests sharing one hop-program signature (and, under QoS, one
+    tenant — fairness picks BETWEEN tenants, so cohorts never mix
+    scopes), awaiting a flush."""
 
-    __slots__ = ("sig", "reqs", "born")
+    __slots__ = ("sig", "reqs", "born", "tenant")
 
-    def __init__(self, sig: tuple):
+    def __init__(self, sig: tuple, tenant: str = ""):
         self.sig = sig
         self.reqs: List[SchedRequest] = []
         self.born = time.monotonic()
+        self.tenant = tenant
 
 
 # ---------------------------------------------------------------- merging

@@ -53,6 +53,18 @@ bounds — [base, 8×base] members, [base/8, base] deadline — via
 ``/debug/planner``).  Responses never depend on either knob, so the
 adaptation is byte-invisible; pinning any knob restores static values.
 
+Multi-tenant QoS (PR 11, sched/qos.py): requests carry a tenant scope
+(``X-Dgraph-Tenant`` / gRPC metadata; absent = ``default``).  Admission
+enforces per-tenant queue quotas (429 + tenant-scoped Retry-After)
+BEFORE the global cap, cohort pick becomes a weighted-fair
+deficit-round-robin across tenants so one tenant's flood cannot starve
+another's flush slots, per-tenant in-flight caps bound execution
+concurrency, and every request carries a ``CancelToken`` the engine
+checkpoints between hop dispatches — deadline lapse, client disconnect
+and ``/admin/cancel`` all stop a query at its next checkpoint.
+``DGRAPH_TPU_QOS=0`` restores this docstring's pre-QoS behavior
+byte-identically.
+
 Knobs (env): ``DGRAPH_TPU_SCHED`` (gate, default on; ``0`` restores the
 serial per-request path byte-identically), ``DGRAPH_TPU_SCHED_MAX_BATCH``
 (default 32), ``DGRAPH_TPU_SCHED_FLUSH_MS`` (default 2.0),
@@ -71,11 +83,13 @@ import time
 from typing import Dict, List, Optional
 
 from dgraph_tpu import obs
+from dgraph_tpu.sched import qos as _qos
 from dgraph_tpu.sched.cohort import (
     Cohort,
     HopMerger,
     SchedDeadlineError,
     SchedOverloadError,
+    SchedQuotaError,
     SchedRequest,
     hop_signature,
 )
@@ -88,6 +102,7 @@ from dgraph_tpu.utils.metrics import (
     SCHED_QUEUE_DEPTH,
     SCHED_QUEUE_WAIT,
     SCHED_SHED,
+    TENANT_SHED,
 )
 
 
@@ -137,11 +152,24 @@ class CohortScheduler:
         # pending cohorts flush early; a fraction of the flush deadline
         self.idle_beat_s = max(self.flush_s / 8.0, 1e-4)
         self._cond = threading.Condition()
+        # admission queues keyed (tenant, hop-signature): cohorts never
+        # mix tenants, so the weighted-fair pick below chooses BETWEEN
+        # scopes while shape bucketing keeps working inside each.  With
+        # QoS off the tenant slot is "" for every key and all QoS
+        # machinery is byte-invisible.
         self._queues: Dict[tuple, Cohort] = {}
         self._depth = 0
         self._last_arrival = 0.0  # monotonic time of the newest admit
         self._stopped = False
         self._flushes = 0   # total cohort flushes (tests/bench introspection)
+        # multi-tenant QoS (sched/qos.py): per-tenant admission quotas,
+        # deficit-round-robin cohort pick, and per-tenant in-flight caps.
+        # None when DGRAPH_TPU_QOS=0 — the whole layer then costs one
+        # None check per decision and the serving path is byte-identical
+        self.qos = _qos.QosConfig.from_env() if _qos.qos_enabled() else None
+        self._drr = _qos.DrrPicker()
+        self._tenant_depth: Dict[str, int] = {}    # admitted − completed
+        self._tenant_inflight: Dict[str, int] = {}  # executing right now
         # singleflight across EXECUTION, not just the queue window:
         # key -> [store_version, leader SchedRequest, [attached reqs]].
         # An identical request arriving while its twin executes attaches
@@ -192,14 +220,24 @@ class CohortScheduler:
         debug: bool = False,
         timeout_s: Optional[float] = None,
         key=None,
+        tenant: str = "",
+        cancel=None,
     ):
         """Admit a read-only parsed request and block until its cohort
         executed.  ``key`` (query text + canonical vars + debug) enables
         singleflight AND tier-2 result caching: equal-key cohort members
         execute once, and a repeat of an already-executed key over the
-        same store snapshot skips admission entirely.  Returns
-        (response dict, engine stats); raises SchedOverloadError /
-        SchedDeadlineError on shed."""
+        same store snapshot skips admission entirely.  ``tenant`` /
+        ``cancel`` are the QoS scope and CancelToken (sched/qos.py; ""
+        and None when QoS is off).  Returns (response dict, engine
+        stats); raises SchedOverloadError / SchedQuotaError /
+        SchedDeadlineError on shed and QueryCancelledError on a flipped
+        token."""
+        # cancel-before-admission: a token that already flipped (client
+        # vanished in transit, admin raced the request) does no work at
+        # all — no queue span, no cache probe, no admission bookkeeping
+        if cancel is not None:
+            cancel.check()
         # duck-typed stores (ClusterStore) may predate .version; 0 keeps
         # them schedulable, merely coalescing across mutation boundaries
         # their own read path already treats as eventually consistent
@@ -235,7 +273,10 @@ class CohortScheduler:
             if timeout_s is not None
             else None
         )
-        req = SchedRequest(parsed, debug=debug, deadline=deadline, key=key)
+        req = SchedRequest(
+            parsed, debug=debug, deadline=deadline, key=key,
+            tenant=tenant, cancel=cancel,
+        )
         sp = obs.current_span()
         if sp is not None:
             # sampled: carry the request's root across the thread hop to
@@ -262,8 +303,36 @@ class CohortScheduler:
         with self._cond:
             if self._stopped:
                 raise SchedOverloadError("scheduler stopped")
+            if self.qos is not None:
+                # per-TENANT quota BEFORE the global cap: an antagonist
+                # tenant hits its own envelope and sheds with a
+                # tenant-scoped Retry-After while everyone else's
+                # admission headroom stays untouched
+                cfg = self.qos.tenant(req.tenant)
+                td = self._tenant_depth.get(req.tenant, 0)
+                if cfg.max_queued > 0 and td >= cfg.max_queued:
+                    SCHED_SHED.add("tenant_quota")
+                    TENANT_SHED.add(
+                        (_qos.metric_label(req.tenant), "quota")
+                    )
+                    # sized to THIS tenant's backlog: roughly how long
+                    # until its queued work drains through the cohort
+                    # machinery, never the server-wide queue depth
+                    ra = max(self.flush_s, 1e-3) * (
+                        1.0 + td / max(1, self.max_batch)
+                    )
+                    raise SchedQuotaError(
+                        f"tenant {req.tenant!r} over admission quota "
+                        f"({td}/{cfg.max_queued} queued)",
+                        tenant=req.tenant,
+                        retry_after=ra,
+                    )
             if self._depth >= self.queue_cap:
                 SCHED_SHED.add("overload")
+                if self.qos is not None:
+                    TENANT_SHED.add(
+                        (_qos.metric_label(req.tenant), "overload")
+                    )
                 raise SchedOverloadError(
                     f"admission queue over capacity ({self.queue_cap})"
                 )
@@ -272,18 +341,52 @@ class CohortScheduler:
                 # an identical request is executing over the same
                 # snapshot right now: attach and share its result
                 ent[2].append(req)
-                self._depth += 1
+                self._note_admitted(req)
                 SCHED_QUEUE_DEPTH.set(self._depth)
                 SCHED_COALESCED.add(1)
             else:
-                c = self._queues.get(sig)
+                qkey = (req.tenant, sig)
+                c = self._queues.get(qkey)
                 if c is None:
-                    c = self._queues[sig] = Cohort(sig)
+                    c = self._queues[qkey] = Cohort(sig, tenant=req.tenant)
                 c.reqs.append(req)
-                self._depth += 1
+                self._note_admitted(req)
                 self._last_arrival = time.monotonic()
                 SCHED_QUEUE_DEPTH.set(self._depth)
                 self._cond.notify_all()
+
+    # -- per-tenant bookkeeping (callers hold self._cond) -------------------
+
+    def _note_admitted(self, req: SchedRequest) -> None:
+        self._depth += 1
+        if self.qos is not None:
+            self._tenant_depth[req.tenant] = (
+                self._tenant_depth.get(req.tenant, 0) + 1
+            )
+
+    def _release_inflight(self, tenant: str, n: int) -> None:
+        """Release reserved in-flight slots (caller holds self._cond).
+        A tenant leaving its cap may unblock a due cohort a worker
+        skipped over — hence the notify."""
+        left = self._tenant_inflight.get(tenant, 0) - n
+        if left > 0:
+            self._tenant_inflight[tenant] = left
+        else:
+            self._tenant_inflight.pop(tenant, None)
+        self._cond.notify_all()
+
+    def _note_done(self, reqs) -> None:
+        """Depth bookkeeping for requests leaving the scheduler (shed,
+        completed, or dealt a twin's result)."""
+        self._depth -= len(reqs)
+        if self.qos is None:
+            return
+        for r in reqs:
+            left = self._tenant_depth.get(r.tenant, 0) - 1
+            if left > 0:
+                self._tenant_depth[r.tenant] = left
+            else:
+                self._tenant_depth.pop(r.tenant, None)
 
     # -- flush workers -----------------------------------------------------
 
@@ -296,47 +399,102 @@ class CohortScheduler:
 
     def _next_cohort(self):
         """Block until some cohort is due, pop and return it.  Priority:
-        full > deadline-expired > idle (oldest first).  While every
-        worker is busy flushing, pending cohorts keep accumulating
-        members — that accumulation IS the continuous batching."""
+        full > deadline-expired > idle (oldest first).  Under QoS,
+        cohorts due in the same class are chosen by a weighted-fair
+        (deficit round-robin) pick ACROSS tenants — so a flood from one
+        tenant earns flush slots only in proportion to its weight — and
+        tenants at their in-flight cap are skipped until a slot frees.
+        While every worker is busy flushing, pending cohorts keep
+        accumulating members — that accumulation IS the continuous
+        batching."""
         with self._cond:
             while True:
                 if self._stopped:
                     return None, None
                 now = time.monotonic()
-                due = None
-                for sig, c in self._queues.items():
-                    if len(c.reqs) >= self.max_batch:
-                        due = (sig, "full")
-                        break
-                if due is None:
-                    for sig, c in self._queues.items():
-                        if now - c.born >= self.flush_s:
-                            due = (sig, "deadline")
-                            break
-                if (
-                    due is None
-                    and self._queues
-                    and now - self._last_arrival >= self.idle_beat_s
-                ):
-                    sig = min(
-                        self._queues, key=lambda s: self._queues[s].born
-                    )
-                    due = (sig, "idle")
+                due = self._due_cohort(now)
                 if due is not None:
-                    sig, reason = due
-                    return self._queues.pop(sig), reason
+                    key, reason = due
+                    cohort = self._queues.pop(key)
+                    if self.qos is not None:
+                        # reserve the in-flight slots HERE, in the same
+                        # lock hold as the admissibility check — a
+                        # second worker deciding before _flush ran
+                        # would otherwise see stale inflight and grant
+                        # the tenant workers×cap concurrency
+                        self._tenant_inflight[cohort.tenant] = (
+                            self._tenant_inflight.get(cohort.tenant, 0)
+                            + len(cohort.reqs)
+                        )
+                    return cohort, reason
                 if not self._queues:
                     self._cond.wait()
                 else:
                     oldest = min(c.born for c in self._queues.values())
-                    self._cond.wait(max(
-                        min(
-                            oldest + self.flush_s - now,
-                            self._last_arrival + self.idle_beat_s - now,
-                        ),
-                        1e-4,
-                    ))
+                    wait = min(
+                        oldest + self.flush_s - now,
+                        self._last_arrival + self.idle_beat_s - now,
+                    )
+                    if wait <= 0:
+                        # everything due is held back by a tenant
+                        # in-flight cap: the cap release notifies this
+                        # condition, so the timed wait is only a
+                        # bounded fallback — never a spin
+                        wait = self.idle_beat_s
+                    self._cond.wait(max(wait, 1e-4))
+
+    def _due_cohort(self, now: float):
+        """(queue key, reason) of the cohort to flush now, or None.
+        Caller holds self._cond."""
+        full, expired = [], []
+        for key, c in self._queues.items():
+            if len(c.reqs) >= self.max_batch:
+                full.append(key)
+            elif now - c.born >= self.flush_s:
+                expired.append(key)
+        key = self._choose(full)
+        if key is not None:
+            return key, "full"
+        key = self._choose(expired)
+        if key is not None:
+            return key, "deadline"
+        if self._queues and now - self._last_arrival >= self.idle_beat_s:
+            # idle beat: the system is quiet, fairness is moot — flush
+            # the oldest pending cohort (legacy behavior), unless its
+            # tenant is at its in-flight cap
+            key = min(self._queues, key=lambda k: self._queues[k].born)
+            if self._tenant_admissible(key[0]):
+                return key, "idle"
+        return None
+
+    def _tenant_admissible(self, tenant: str) -> bool:
+        if self.qos is None:
+            return True
+        cap = self.qos.tenant(tenant).max_inflight
+        return cap <= 0 or self._tenant_inflight.get(tenant, 0) < cap
+
+    def _choose(self, keys):
+        """Pick one due queue key out of ``keys``.  QoS off: the first
+        in iteration (insertion) order — the legacy scan's choice,
+        byte-identical.  QoS on: drop tenants at their in-flight cap,
+        DRR-pick a tenant by weight, then that tenant's oldest cohort."""
+        if not keys:
+            return None
+        if self.qos is None:
+            return keys[0]
+        by_tenant: Dict[str, list] = {}
+        for k in keys:
+            if self._tenant_admissible(k[0]):
+                by_tenant.setdefault(k[0], []).append(k)
+        if not by_tenant:
+            return None
+        if len(by_tenant) == 1:
+            t = next(iter(by_tenant))
+        else:
+            t = self._drr.pick(
+                {t: self.qos.tenant(t).weight for t in by_tenant}
+            )
+        return min(by_tenant[t], key=lambda k: self._queues[k].born)
 
     # -- execution ---------------------------------------------------------
 
@@ -345,6 +503,7 @@ class CohortScheduler:
         SCHED_COHORT_OCCUPANCY.observe(len(cohort.reqs))
         now = time.monotonic()
         live: List[SchedRequest] = []
+        shed: List[SchedRequest] = []
         max_wait = 0.0
         for req in cohort.reqs:
             w = now - req.enqueued
@@ -352,6 +511,7 @@ class CohortScheduler:
             SCHED_QUEUE_WAIT.observe(w)
             if req.expired(now):
                 self._shed_deadline(req, now)
+                shed.append(req)
             else:
                 live.append(req)
         with self._cond:
@@ -360,9 +520,14 @@ class CohortScheduler:
             # they complete — so a blocked engine (writer holding the
             # lock) backs admission up into 429s instead of unbounded
             # thread/memory growth
-            self._depth -= len(cohort.reqs) - len(live)
+            self._note_done(shed)
             SCHED_QUEUE_DEPTH.set(self._depth)
             self._flushes += 1
+            if self.qos is not None and shed:
+                # in-flight slots were reserved for the WHOLE cohort at
+                # pop time (_next_cohort); release the shed members'
+                # share now — only the live ones actually execute
+                self._release_inflight(cohort.tenant, len(shed))
         if not live:
             # a fully-shed cohort is the STRONGEST overload signal the
             # controller can get — its queue waits must reach the EWMA
@@ -419,27 +584,42 @@ class CohortScheduler:
             # req.fail below instead of killing the worker loop
             fail.point("sched.flush")
             with srv._engine_lock.read():  # ONE read acquisition per cohort
+                # tenant in-flight cap bounds EXECUTION concurrency, not
+                # just cohort pick: a batch-class tenant with
+                # max_inflight=1 runs its cohort's leaders in waves of 1
+                # instead of fanning the whole cohort onto threads — the
+                # CPU-side half of antagonist isolation (the pick-time
+                # check alone would still let one flush monopolize the
+                # cores)
+                wave = len(leaders)
+                if self.qos is not None:
+                    mi = self.qos.tenant(cohort.tenant).max_inflight
+                    if mi > 0:
+                        wave = min(wave, mi)
                 if len(leaders) == 1:
                     self._run_one(leaders[0], merger, flush_span)
                 else:
-                    # fresh threads per flush, not a persistent pool:
-                    # spawn cost (~100µs each) is noise next to cohort
-                    # service time, occupancy keeps the count small, and
-                    # a shared pool would need anti-starvation sizing
-                    # across concurrent flushes
-                    threads = [
-                        threading.Thread(
-                            target=self._run_one,
-                            args=(req, merger, flush_span),
-                            name="dgraph-cohort", daemon=True,
-                        )
-                        for req in leaders[1:]
-                    ]
-                    for t in threads:
-                        t.start()
-                    self._run_one(leaders[0], merger, flush_span)
-                    for t in threads:
-                        t.join()
+                    for lo in range(0, len(leaders), wave):
+                        batch = leaders[lo : lo + wave]
+                        # fresh threads per wave, not a persistent pool:
+                        # spawn cost (~100µs each) is noise next to
+                        # cohort service time, occupancy keeps the count
+                        # small, and a shared pool would need
+                        # anti-starvation sizing across concurrent
+                        # flushes
+                        threads = [
+                            threading.Thread(
+                                target=self._run_one,
+                                args=(req, merger, flush_span),
+                                name="dgraph-cohort", daemon=True,
+                            )
+                            for req in batch[1:]
+                        ]
+                        for t in threads:
+                            t.start()
+                        self._run_one(batch[0], merger, flush_span)
+                        for t in threads:
+                            t.join()
                 for k, followers in dups.items():
                     lead = seen[k]
                     for req in followers:
@@ -466,14 +646,16 @@ class CohortScheduler:
                     ent = self._inflight.pop(req.key, None)
                     if ent is not None:
                         attached.append((req, ent[2]))
-            n_att = 0
+            done: List[SchedRequest] = list(live)
             for lead, followers in attached:
-                n_att += len(followers)
                 for req in followers:
                     self._complete_follower(req, lead, merger)
+                    done.append(req)
             with self._cond:
-                self._depth -= len(live) + n_att
+                self._note_done(done)
                 SCHED_QUEUE_DEPTH.set(self._depth)
+                if self.qos is not None and live:
+                    self._release_inflight(cohort.tenant, len(live))
             if flush_span is not None:
                 flush_span.set_attr(
                     "merged_hops", merger.merged_dispatches
@@ -518,6 +700,8 @@ class CohortScheduler:
 
     def _shed_deadline(self, req: SchedRequest, now: float) -> None:
         SCHED_SHED.add("deadline")
+        if self.qos is not None:
+            TENANT_SHED.add((_qos.metric_label(req.tenant), "deadline"))
         req.fail(SchedDeadlineError(
             "deadline expired while queued "
             f"({(now - req.enqueued) * 1e3:.1f}ms in cohort)"
@@ -536,6 +720,11 @@ class CohortScheduler:
                 # lock (a long write was in front of us): shed, don't run
                 self._shed_deadline(req, time.monotonic())
                 return
+            if req.cancel is not None and req.cancel.cancelled:
+                # cancelled between admission and execution (client
+                # disconnect / admin): never touch the engine
+                req.fail(req.cancel.error())
+                return
             req.end_queue_wait("run")
             # re-root this worker thread under the admitting request's
             # trace: the engine span parents to the REQUEST (it is that
@@ -551,6 +740,9 @@ class CohortScheduler:
                 eng = QueryEngine(srv.store, arenas=srv.engine.arenas)
                 eng.chain_threshold = srv.engine.chain_threshold
                 eng.expander.hop_merger = merger
+                # cooperative cancellation (sched/qos.py): the engine
+                # checkpoints this token at hop-dispatch boundaries
+                eng.cancel = req.cancel
                 eng.dump_shapes = bool(srv.dumpsg_path)
                 token = outputnode.DEBUG_UIDS.set(req.debug)
                 try:
@@ -566,6 +758,22 @@ class CohortScheduler:
         finally:
             merger.leave()
 
+    # -- introspection -----------------------------------------------------
+
+    def qos_state(self) -> Optional[dict]:
+        """The /debug/store "qos" snapshot: tenant table, live per-tenant
+        queue depth and in-flight counts.  None when QoS is off."""
+        if self.qos is None:
+            return None
+        with self._cond:
+            depth = dict(self._tenant_depth)
+            inflight = dict(self._tenant_inflight)
+        return {
+            "tenants": self.qos.snapshot(),
+            "queued": depth,
+            "inflight": inflight,
+        }
+
     # -- lifecycle ---------------------------------------------------------
 
     def stop(self) -> None:
@@ -578,6 +786,8 @@ class CohortScheduler:
             pending = [r for c in self._queues.values() for r in c.reqs]
             self._queues.clear()
             self._depth = 0
+            self._tenant_depth.clear()
+            self._tenant_inflight.clear()
             SCHED_QUEUE_DEPTH.set(0)
             self._cond.notify_all()
         for req in pending:

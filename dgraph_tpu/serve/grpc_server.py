@@ -453,31 +453,66 @@ class GrpcServer:
         if not text.strip():
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty request")
         # propagate the client's gRPC deadline into the cohort
-        # scheduler's per-request budget: a request that cannot make its
-        # deadline sheds (DEADLINE_EXCEEDED) instead of queueing forever
+        # scheduler's per-request budget via the SAME deadline
+        # resolution the HTTP surface uses (sched/qos.py — the two
+        # near-copies had started to drift): a request that cannot make
+        # its deadline sheds (DEADLINE_EXCEEDED) instead of queueing
+        # forever, and under QoS the deadline also bounds EXECUTION
+        # through the request's CancelToken
+        from dgraph_tpu.sched import qos as _qos
+
+        timeout_s = _qos.grpc_timeout(context)
+        # tenant scope + client-disconnect probe: gRPC metadata keys are
+        # lowercased by grpc; context.is_active() flips false when the
+        # caller cancelled or hung up, which the engine's checkpoints
+        # turn into cooperative cancellation
         try:
-            timeout_s = context.time_remaining()
-        except Exception:  # transport without deadline support
-            timeout_s = None
-        if timeout_s is not None and timeout_s > 1e8:
-            timeout_s = None  # "no deadline" sentinel from grpcio
+            md = dict(context.invocation_metadata())
+        except Exception:  # noqa: BLE001 — metadata is optional
+            md = {}
+        tenant = md.get("x-dgraph-tenant", "")
+
+        def _client_gone() -> bool:
+            try:
+                return not context.is_active()
+            except Exception:  # noqa: BLE001 — transport quirk: assume live
+                return False
+
         # W3C trace propagation over the gRPC leg: traceparent rides
-        # invocation metadata (keys are lowercased by grpc); malformed
-        # values parse to None and are ignored, never an error
+        # invocation metadata; malformed values parse to None and are
+        # ignored, never an error
         tctx = self._md_trace_ctx(context)
         try:
             out = self._server.run_query(text, vars_ or None,
                                          timeout_s=timeout_s,
-                                         trace_ctx=tctx)
+                                         trace_ctx=tctx,
+                                         tenant=tenant,
+                                         cancel_probe=_client_gone)
         except Exception as e:
             from dgraph_tpu.cluster.peerclient import StaleUnavailableError
             from dgraph_tpu.models.durability import StorageFaultError
-            from dgraph_tpu.sched import SchedDeadlineError, SchedOverloadError
+            from dgraph_tpu.sched import (
+                QueryCancelledError,
+                SchedDeadlineError,
+                SchedOverloadError,
+            )
 
             if isinstance(e, SchedOverloadError):
+                # SchedQuotaError included: RESOURCE_EXHAUSTED either
+                # way (the tenant-scoped retry hint is an HTTP header
+                # nicety; gRPC clients back off on the status code)
                 context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
             if isinstance(e, SchedDeadlineError):
                 context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+            if isinstance(e, QueryCancelledError):
+                # mid-execution deadline lapse reads like the queued
+                # shed; disconnect/admin cancels read as CANCELLED
+                context.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED
+                    if e.reason == "deadline"
+                    else grpc.StatusCode.CANCELLED,
+                    str(e),
+                )
             if isinstance(e, StorageFaultError):
                 # disk fault / read-only mode: mutation not acknowledged,
                 # retriable after the re-arm probe (HTTP's 503 twin).

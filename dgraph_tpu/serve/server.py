@@ -33,15 +33,20 @@ from dgraph_tpu.utils.rwlock import RWLock
 from dgraph_tpu.utils.metrics import (
     NUM_QUERIES,
     PENDING_QUERIES,
+    QUERY_CANCELLED,
     QUERY_LATENCY,
+    TENANT_LATENCY,
     metrics,
 )
 from dgraph_tpu.cluster.peerclient import StaleUnavailableError
 from dgraph_tpu.sched import (
+    QueryCancelledError,
     SchedDeadlineError,
     SchedOverloadError,
+    SchedQuotaError,
     sched_enabled,
 )
+from dgraph_tpu.sched import qos as _qos
 from dgraph_tpu.utils.trace import Tracer
 
 _CORS = {
@@ -260,13 +265,24 @@ class DgraphServer:
         debug: bool = False,
         timeout_s: Optional[float] = None,
         trace_ctx=None,
+        tenant: str = "",
+        cancel_probe=None,
     ) -> dict:
         """The ParseQueryAndMutation → ProcessWithMutation → encode path
         with the reference's latency breakdown (query/query.go:102).
 
         ``timeout_s`` is the caller's remaining budget (gRPC deadline /
         X-Dgraph-Timeout header): a scheduled request past it sheds with
-        SchedDeadlineError instead of sitting in a cohort queue.
+        SchedDeadlineError while queued, and — under QoS — its
+        CancelToken stops execution at the next hop-dispatch checkpoint
+        once the budget lapses mid-flight (504 either way; the engine
+        stops burning time for a client that already gave up).
+
+        ``tenant`` is the QoS scope (X-Dgraph-Tenant / gRPC metadata;
+        absent = default tenant) and ``cancel_probe`` an optional
+        transport-liveness callable (returns True when the client is
+        GONE) that turns client disconnects into cooperative
+        cancellation.  Both are inert under DGRAPH_TPU_QOS=0.
 
         ``trace_ctx`` (obs.TraceContext) is the caller's incoming W3C
         traceparent, if any: a sampled upstream makes this request's
@@ -281,11 +297,23 @@ class DgraphServer:
         tr = self.tracer.begin()
         lat = Latency()
         t0 = time.monotonic()
+        sched = self.scheduler
+        qos_on = sched is not None and sched.qos is not None
+        token = None
+        if qos_on:
+            tenant = _qos.resolve_tenant(tenant)
+            token = _qos.CancelToken(timeout_s, tenant=tenant)
+            if cancel_probe is not None:
+                token.attach_probe(cancel_probe)
+        else:
+            tenant = ""
         root = obs.start_request("query", trace_ctx)
         if root is not None:
             root.set_attr("query", text[:200])
             if self.cluster is not None:
                 root.set_attr("node", self.cluster.node_id)
+            if qos_on:
+                root.set_attr("tenant", tenant)
             root.__enter__()  # paired with __exit__ in the finally below
         try:
             with obs.child("parsing"):
@@ -325,9 +353,19 @@ class DgraphServer:
                         json.dumps(variables, sort_keys=True)
                         if variables else ""
                     )
+                    if token is not None and root is not None:
+                        # the /admin/cancel?trace_id= hook: registered
+                        # ONLY for scheduled reads — the inline
+                        # mutation/profiled path has no checkpoints, and
+                        # the endpoint must 404 rather than claim a
+                        # cancel it cannot deliver.  Sampled requests
+                        # are exactly the ones an operator can see (and
+                        # therefore target) in /debug/traces.
+                        _qos.REGISTRY.register(root.trace_id, token)
                     result, stats = self.scheduler.run(
                         parsed, debug=debug, timeout_s=timeout_s,
                         key=(text, vkey, debug),
+                        tenant=tenant, cancel=token,
                     )
                     out.update(result)
                 else:
@@ -371,13 +409,27 @@ class DgraphServer:
         except BaseException as e:
             if root is not None:
                 root.set_attr("error", type(e).__name__)
+                if isinstance(e, QueryCancelledError):
+                    # the PR-7 span contract: a cancelled query's trace
+                    # says so explicitly, not just via the error class
+                    root.set_attr("outcome", "cancelled")
+            if isinstance(e, QueryCancelledError):
+                QUERY_CANCELLED.add(
+                    (e.reason, _qos.metric_label(tenant or e.tenant))
+                )
             raise
         finally:
             PENDING_QUERIES.add(-1)
             dur = time.monotonic() - t0
             trace_id = root.trace_id if root is not None else None
             if root is not None:
+                if token is not None:
+                    # identity-checked: a concurrent request sharing
+                    # this trace id keeps ITS registration
+                    _qos.REGISTRY.unregister(root.trace_id, token)
                 root.__exit__(None, None, None)  # publish to the ring
+            if qos_on:
+                TENANT_LATENCY.observe(_qos.metric_label(tenant), dur)
             # slow-query tail sampling is independent of the head
             # sampler: an offender at ratio 0 still gets a structured
             # log line and a synthetic trace (obs/spans.py note_slow) —
@@ -540,6 +592,13 @@ def _make_handler(srv: DgraphServer):
                 with srv._engine_lock.read():
                     stats = _store_stats(srv.store)
                 stats["qcache"] = _qcache_stats(srv)
+                # multi-tenant QoS: tenant table + live queue/inflight
+                # depths (None when DGRAPH_TPU_QOS=0 or scheduler off)
+                stats["qos"] = (
+                    srv.scheduler.qos_state()
+                    if srv.scheduler is not None
+                    else None
+                )
                 # MXU join tier: route counts + the recent decision ring
                 # (mxu vs pairwise with the cost estimates that drove
                 # each choice) — the chain_reject explainability,
@@ -645,6 +704,24 @@ def _make_handler(srv: DgraphServer):
                         self._err(500, "snapshot failed; see /health?detail=1")
                 else:
                     self._err(404, "store has no snapshotter")
+            elif path == "/admin/cancel":
+                # explicit cooperative cancellation: flip the live
+                # CancelToken registered under this trace id (sampled
+                # requests only — exactly the ones visible in
+                # /debug/traces).  The query stops at its next
+                # hop-dispatch checkpoint and answers 499/504; this
+                # endpoint merely flips the flag.
+                qs = parse_qs(u.query)
+                tid = qs.get("trace_id", [""])[0]
+                if not tid:
+                    return self._err(400, "trace_id required")
+                if _qos.REGISTRY.cancel(tid, reason="admin"):
+                    self._reply(200, json.dumps({
+                        "code": "Success",
+                        "message": f"cancel requested for trace {tid}",
+                    }).encode())
+                else:
+                    self._err(404, "no live query under that trace_id")
             elif path == "/admin/shutdown":
                 self._reply(200, json.dumps(
                     {"code": "Success", "message": "Server is shutting down"}
@@ -707,6 +784,34 @@ def _make_handler(srv: DgraphServer):
                 self._reply(200, json.dumps(names).encode())
             else:
                 self._err(404, "no such endpoint")
+
+        def _disconnect_probe(self):
+            """Transport-liveness probe for cooperative cancellation
+            (None when QoS is off — zero overhead on the legacy path).
+            A closed client connection makes the socket readable with
+            EOF; MSG_PEEK observes that without consuming pipelined
+            bytes.  TLS sockets reject recv flags — there the probe
+            reports 'still connected' (deadline and /admin/cancel still
+            work; documented in docs/deploy.md)."""
+            if srv.scheduler is None or srv.scheduler.qos is None:
+                return None
+            import select
+            import socket as _socket
+
+            sock = self.connection
+
+            def gone() -> bool:
+                try:
+                    r, _w, _x = select.select([sock], [], [], 0)
+                    if not r:
+                        return False
+                    return sock.recv(1, _socket.MSG_PEEK) == b""
+                except ValueError:
+                    return False  # SSLSocket: flags unsupported
+                except OSError:
+                    return True   # socket already torn down
+
+            return gone
 
         def _cluster_authorized(self) -> bool:
             """Gate for the intra-cluster control plane (/raft*, /assign-uids):
@@ -816,13 +921,13 @@ def _make_handler(srv: DgraphServer):
                 try:
                     vars_hdr = self.headers.get("X-Dgraph-Vars")
                     variables = json.loads(vars_hdr) if vars_hdr else None
-                    # request budget (seconds): propagated into the cohort
-                    # scheduler's per-request deadline
-                    tmo_hdr = self.headers.get("X-Dgraph-Timeout")
-                    try:
-                        timeout_s = float(tmo_hdr) if tmo_hdr else None
-                    except ValueError:
-                        timeout_s = None
+                    # request budget (seconds): ONE deadline resolution
+                    # shared with the gRPC surface (sched/qos.py) —
+                    # queued AND (under QoS) executing phases both honor
+                    # it
+                    timeout_s = _qos.parse_timeout(
+                        self.headers.get("X-Dgraph-Timeout")
+                    )
                     # a malformed traceparent parses to None — an
                     # attacker-controlled header must never 500 a query
                     tctx = obs.parse_traceparent(
@@ -831,6 +936,8 @@ def _make_handler(srv: DgraphServer):
                     out = srv.run_query(
                         body, variables, debug=debug, timeout_s=timeout_s,
                         trace_ctx=tctx,
+                        tenant=self.headers.get("X-Dgraph-Tenant") or "",
+                        cancel_probe=self._disconnect_probe(),
                     )
                     accept = self.headers.get("Accept", "")
                     if "application/protobuf" in accept or "application/x-protobuf" in accept:
@@ -844,6 +951,23 @@ def _make_handler(srv: DgraphServer):
                         )
                     else:
                         self._reply(200, json.dumps(out).encode())
+                except SchedQuotaError as e:
+                    # per-TENANT quota shed: still a 429, but with a
+                    # Retry-After sized to that tenant's own backlog —
+                    # the antagonist gets back-pressure scoped to itself
+                    self._reply(
+                        429,
+                        json.dumps({
+                            "code": "ErrorServiceUnavailable",
+                            "message": str(e),
+                            "tenant": e.tenant,
+                        }).encode(),
+                        extra_headers={
+                            "Retry-After": str(
+                                max(1, int(round(e.retry_after)))
+                            )
+                        },
+                    )
                 except SchedOverloadError as e:
                     # shed under overload: retriable, not a client error
                     self._reply(429, json.dumps(
@@ -853,6 +977,26 @@ def _make_handler(srv: DgraphServer):
                     self._reply(504, json.dumps(
                         {"code": "ErrorDeadlineExceeded", "message": str(e)}
                     ).encode())
+                except QueryCancelledError as e:
+                    # cooperative cancellation: a deadline that lapsed
+                    # MID-EXECUTION reads exactly like the queued-shed
+                    # 504; disconnect/admin cancels get 499 (the nginx
+                    # client-closed-request convention).  The reply may
+                    # race a vanished client — that write failing is the
+                    # expected outcome, never an error to surface.
+                    try:
+                        if e.reason == "deadline":
+                            self._reply(504, json.dumps({
+                                "code": "ErrorDeadlineExceeded",
+                                "message": str(e),
+                            }).encode())
+                        else:
+                            self._reply(499, json.dumps({
+                                "code": "ErrorQueryCancelled",
+                                "message": str(e),
+                            }).encode())
+                    except OSError:
+                        self.close_connection = True
                 except StorageFaultError as e:
                     # disk fault / read-only mode: the mutation was NOT
                     # acknowledged; retriable once the re-arm probe

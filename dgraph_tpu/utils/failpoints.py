@@ -36,7 +36,10 @@ faults.  Triggers are counted per site in
 Instrumented sites (grep ``fail.point``): every PeerClient attempt
 (``peerclient.<op>`` — forward, snapshot, predlist, assign, join,
 raft.send), snapshot decode (``service.snapshot_decode``), the cohort
-scheduler's flush (``sched.flush``), and the storage plane's
+scheduler's flush (``sched.flush``), the engine's per-level hop
+dispatch (``engine.hop`` — the cancellation-checkpoint seam; arm
+``delay(ms=...)`` to stretch it for mid-flight cancel tests), and the
+storage plane's
 durability-critical sites (``wal.append``, ``wal.flush``,
 ``wal.post_flush``, ``wal.seal``, ``wal.snapshot.{tmp,replace,
 installed}``, ``raft.log_append``, ``raft.hardstate.{tmp,replace}``,

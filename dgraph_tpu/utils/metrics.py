@@ -203,6 +203,49 @@ class Histogram:
             return self._sum / self._count if self._count else 0.0
 
 
+class LabeledHistogram:
+    """Histogram family keyed by one label (per-tenant latency needs
+    percentiles PER TENANT, and packing the tenant into the metric name
+    would break every aggregation).  Series are created on first
+    observe; the key space is BOUNDED (``max_series``) because label
+    values may come from client input — the overflow tail collapses
+    into one ``overflow`` series (the SAME sentinel qos.metric_label
+    uses for counters, so latency and shed series for overflow
+    tenants line up on a dashboard) instead of minting unbounded
+    exposition lines."""
+
+    def __init__(self, name: str, label: str, buckets, max_series: int = 64):
+        self.name = name
+        self.label = label
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.max_series = max_series
+        self._m: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, key: str) -> Histogram:
+        with self._lock:
+            h = self._m.get(key)
+            if h is None:
+                if len(self._m) >= self.max_series:
+                    key = "overflow"
+                    h = self._m.get(key)
+                if h is None:
+                    h = self._m[key] = Histogram(self.name, self.buckets)
+            return h
+
+    def observe(self, key: str, v: float, trace_id: Optional[str] = None) -> None:
+        self._get(str(key)).observe(v, trace_id=trace_id)
+
+    def histogram(self, key: str) -> Optional[Histogram]:
+        with self._lock:
+            return self._m.get(key)
+
+    def snapshot(self) -> Dict[str, tuple]:
+        with self._lock:
+            items = list(self._m.items())
+        return {k: h.snapshot() for k, h in items}
+
+
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -212,6 +255,7 @@ class MetricsRegistry:
         self._multilabeled: Dict[str, MultiLabeledCounter] = {}
         self._labeled_gauges: Dict[str, LabeledGauge] = {}
         self._histograms: Dict[str, Histogram] = {}
+        self._labeled_histograms: Dict[str, LabeledHistogram] = {}
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -255,6 +299,17 @@ class MetricsRegistry:
                 h = self._histograms[name] = Histogram(name, buckets)
             return h
 
+    def labeled_histogram(
+        self, name: str, label: str, buckets
+    ) -> LabeledHistogram:
+        with self._lock:
+            h = self._labeled_histograms.get(name)
+            if h is None:
+                h = self._labeled_histograms[name] = LabeledHistogram(
+                    name, label, buckets
+                )
+            return h
+
     def prometheus_text(self) -> str:
         """Prometheus text exposition format (the collector at
         x/metrics.go:119 re-done natively)."""
@@ -266,6 +321,7 @@ class MetricsRegistry:
             multilabeled = list(self._multilabeled.values())
             labeled_gauges = list(self._labeled_gauges.values())
             histograms = list(self._histograms.values())
+            labeled_histograms = list(self._labeled_histograms.values())
 
         def _esc(s: str) -> str:
             return s.replace("\\", "\\\\").replace('"', '\\"')
@@ -299,6 +355,19 @@ class MetricsRegistry:
             lines.append(f'{h.name}_bucket{{le="+Inf"}} {c}')
             lines.append(f"{h.name}_sum {s:g}")
             lines.append(f"{h.name}_count {c}")
+        for lh in sorted(labeled_histograms, key=lambda h: h.name):
+            lines.append(f"# TYPE {lh.name} histogram")
+            for key, (cum, s, c) in sorted(lh.snapshot().items()):
+                kq = _esc(key)
+                for b, n in zip(lh.buckets, cum):
+                    lines.append(
+                        f'{lh.name}_bucket{{{lh.label}="{kq}",le="{b:g}"}} {n}'
+                    )
+                lines.append(
+                    f'{lh.name}_bucket{{{lh.label}="{kq}",le="+Inf"}} {c}'
+                )
+                lines.append(f'{lh.name}_sum{{{lh.label}="{kq}"}} {s:g}')
+                lines.append(f'{lh.name}_count{{{lh.label}="{kq}"}} {c}')
         return "\n".join(lines) + "\n"
 
     def openmetrics_text(self) -> str:
@@ -365,6 +434,10 @@ _LATENCY_BUCKETS = (
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+TENANT_LATENCY = metrics.labeled_histogram(
+    "dgraph_tenant_query_latency_seconds", "tenant", _LATENCY_BUCKETS
+)
+
 # cohort scheduler surface (sched/scheduler.py): how full cohorts ride,
 # why they flushed, how long requests queued, end-to-end query latency
 QUERY_LATENCY = metrics.histogram(
@@ -381,6 +454,21 @@ SCHED_SHED = metrics.labeled("dgraph_sched_shed_total", label="reason")
 SCHED_MERGED_HOPS = metrics.counter("dgraph_sched_merged_hops_total")
 SCHED_COALESCED = metrics.counter("dgraph_sched_coalesced_requests_total")
 SCHED_QUEUE_DEPTH = metrics.gauge("dgraph_sched_queue_depth")
+
+# multi-tenant QoS surface (sched/qos.py): every cancelled query lands
+# in QUERY_CANCELLED with {reason ∈ deadline/disconnect/admin, tenant};
+# per-tenant sheds (quota / overload / deadline) in TENANT_SHED; and
+# per-tenant end-to-end latency percentiles in TENANT_LATENCY (bounded
+# series — tenant names are client input, the tail collapses to
+# "overflow").  Alert on a victim tenant's p99 and on any tenant's
+# quota-shed rate: sustained quota sheds mean the tenant's envelope is
+# too small OR an antagonist is being correctly contained.
+QUERY_CANCELLED = metrics.multilabeled(
+    "dgraph_query_cancelled_total", ("reason", "tenant")
+)
+TENANT_SHED = metrics.multilabeled(
+    "dgraph_tenant_shed_total", ("tenant", "reason")
+)
 
 # two-tier query cache surface (dgraph_tpu/cache/): per-tier event
 # counters (hit / miss / stale / evicted / rejected), occupancy-bytes

@@ -23,6 +23,7 @@ from dgraph_tpu.analysis.rules import (
     NakedStageTiming,
     RecompileHazard,
     SwallowedException,
+    UncheckedHopLoop,
     WallClockDuration,
 )
 from dgraph_tpu.analysis import witness as witness_mod
@@ -559,6 +560,101 @@ def test_naked_route_threshold_counterexamples_clean():
     """)
     assert check_source(
         pragmad, [NakedRouteThreshold()], path="dgraph_tpu/ops/kern.py"
+    ) == []
+
+
+def test_unchecked_hop_loop_flagged():
+    # the PR-11 origin story: a per-level expansion loop that never
+    # checkpoints the request's CancelToken — a cancelled query keeps
+    # dispatching hops here
+    src = textwrap.dedent("""
+        def run_levels(engine, levels, src, resolver):
+            for child in levels:
+                engine._exec_child(child, src, resolver, {}, {})
+    """)
+    assert _ids(
+        check_source(
+            src, [UncheckedHopLoop()], path="dgraph_tpu/query/newpath.py"
+        )
+    ) == ["unchecked-hop-loop"]
+    # the local-wrapper shape (shortest.py's lazy expander): a bare
+    # expand() call in a search loop is the same seam
+    src2 = textwrap.dedent("""
+        def search(expand, heap):
+            while heap:
+                u = heap.pop()
+                expand(u)
+    """)
+    assert _ids(
+        check_source(
+            src2, [UncheckedHopLoop()], path="dgraph_tpu/query/walk.py"
+        )
+    ) == ["unchecked-hop-loop"]
+
+
+def test_unchecked_hop_loop_counterexamples_clean():
+    # the fix: a checkpoint inside the loop (method or token form)
+    checked = textwrap.dedent("""
+        def run_levels(engine, levels, src, resolver):
+            for child in levels:
+                engine.checkpoint()
+                engine._exec_child(child, src, resolver, {}, {})
+
+        def probe_tokens(self, idx, toks):
+            for t in toks:
+                self.cancel_token.check()
+                self._expand_rows(idx.csr, [t])
+    """)
+    assert check_source(
+        checked, [UncheckedHopLoop()], path="dgraph_tpu/query/newpath.py"
+    ) == []
+    # a loop that never touches the dispatch seam is not a hop loop
+    plain = textwrap.dedent("""
+        def tally(children):
+            total = 0
+            for c in children:
+                total += len(c.values)
+            return total
+    """)
+    assert check_source(
+        plain, [UncheckedHopLoop()], path="dgraph_tpu/query/enc.py"
+    ) == []
+    # outside query/ the rule does not apply: ops/ loops run inside
+    # jitted programs where a checkpoint is impossible by design
+    outside = textwrap.dedent("""
+        def kernel(ce, fronts):
+            for f in fronts:
+                ce.expand(f)
+    """)
+    assert check_source(
+        outside, [UncheckedHopLoop()], path="dgraph_tpu/ops/kern.py"
+    ) == []
+    # pragma escape hatch with the WHY
+    pragmad = textwrap.dedent("""
+        def replay(engine, levels, src, resolver):
+            # replay of an already-admitted fixture: no live client
+            # graftlint: ignore[unchecked-hop-loop]
+            for child in levels:
+                engine._exec_child(child, src, resolver, {}, {})
+    """)
+    assert check_source(
+        pragmad, [UncheckedHopLoop()], path="dgraph_tpu/query/fixture.py"
+    ) == []
+
+
+def test_unchecked_hop_loop_nested_checkpoint_covers_outer():
+    # a checkpoint in the innermost loop satisfies every enclosing loop
+    # (the outer iteration cannot advance without passing through it)
+    src = textwrap.dedent("""
+        def walk(engine, parents, templates, src, resolver):
+            while parents:
+                for tmpl in templates:
+                    engine.checkpoint()
+                    engine._exec_child(tmpl, src, resolver, {}, {})
+                parents = parents[1:]
+    """)
+    assert check_source(
+        src, [UncheckedHopLoop()], path="dgraph_tpu/query/walk2.py"
     ) == []
 
 

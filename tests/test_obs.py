@@ -540,7 +540,15 @@ def test_cluster_two_hop_trace_covers_all_layers(cluster2):
     covering server → scheduler (queue-wait + linked cohort flush) →
     cache probe → per-hop execution (edges + route attrs) → peer RPC
     attempts toward the remote node — with consistent parent links and
-    monotone [t0, t1] nesting, asserted span by span."""
+    monotone [t0, t1] nesting, asserted span by span.
+
+    Deflaked (PR 11): spans land in the ring ASYNCHRONOUSLY — flush
+    workers and peer-RPC legs may finish after the response returns, so
+    on a busy host a trace snapshot taken immediately can be missing
+    late spans (the known ~5/8 failure from the PR-10 notes).  The
+    structural preconditions are therefore condition-POLLED with a
+    bounded deadline (the PR-5 _post_retry discipline); the detailed
+    assertions then run on a settled snapshot."""
     n1, _n2 = cluster2
     obs.configure(ratio=1e-9)  # armed: honor the header
     # bust the remote-snapshot TTL cache so the query truly crosses
@@ -552,8 +560,39 @@ def test_cluster_two_hop_trace_covers_all_layers(cluster2):
         headers={"Traceparent": _tp(2001)},
     )
     assert out["q"][0]["follows"][0]["follows"] == [{"name": "Carol"}]
-    t = _get(n1.addr, f"/debug/traces/{_tid(2001)}")
-    spans = t["spans"]
+
+    WANT = ("query", "processing", "sched.queue", "sched.flush",
+            "engine", "hop", "cache.hop")
+
+    def settled():
+        t = _get(n1.addr, f"/debug/traces/{_tid(2001)}")
+        spans = t["spans"]
+        names = {s["name"] for s in spans}
+        if any(w not in names for w in WANT):
+            return None
+        if not any(s["name"].startswith("rpc.") for s in spans):
+            return None
+        if not any(s["name"] == "peer.pred-snapshot" for s in spans):
+            return None
+        # every wanted span must have FINISHED (dur stamped): a span
+        # mid-flight still shows up in the shared buffer only at close
+        if any(
+            s["dur_us"] is None for s in spans if s["name"] in WANT
+        ):
+            return None
+        return spans
+
+    deadline = time.monotonic() + 30.0
+    spans = None
+    while time.monotonic() < deadline:
+        spans = settled()
+        if spans is not None:
+            break
+        time.sleep(0.1)
+    assert spans is not None, (
+        "trace never settled with all layers present: "
+        f"{[s['name'] for s in _get(n1.addr, f'/debug/traces/{_tid(2001)}')['spans']]}"
+    )
     names = [s["name"] for s in spans]
     by_name = {s["name"]: s for s in spans}
 
@@ -584,14 +623,27 @@ def test_cluster_two_hop_trace_covers_all_layers(cluster2):
     assert remote[0]["attrs"]["pred"] == "name"
 
     # every parent link resolves or points at the remote caller span,
-    # and child intervals nest inside their parents
+    # and REQUEST-THREAD child intervals nest inside their parents.
+    # Two span classes are asynchronous to the request by design and
+    # excluded from the nesting check (both traced to the 5/8 busy-host
+    # failures): remote-side server spans (peer.*) — a timed-out first
+    # RPC attempt gets retried, and the abandoned attempt's handler on
+    # the other node finishes AFTER the local parent closed — and the
+    # cohort-shared sched.flush span, which the flush WORKER closes
+    # after dealing results, by which time the member's processing span
+    # may already be done.  Out-living there is the machinery working,
+    # not a trace bug.
     ids = {s["span_id"] for s in spans}
     roots = [s for s in spans if s["parent_id"] not in ids]
     for r in roots:
         # dangling parents are exactly: the inbound header's span (the
         # synthetic test caller) and the cross-thread rpc parents
         assert r["parent_id"] is None or len(r["parent_id"]) == 16
-    assert _assert_monotone_nesting(spans) >= 6
+    sync_spans = [
+        s for s in spans
+        if not s["name"].startswith("peer.") and s["name"] != "sched.flush"
+    ]
+    assert _assert_monotone_nesting(sync_spans) >= 6
 
 
 def test_cluster_forwarded_mutation_spans_on_both_nodes(cluster2):

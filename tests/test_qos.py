@@ -1,0 +1,733 @@
+"""Multi-tenant QoS (dgraph_tpu/sched/qos.py + the serving wiring):
+cancel tokens (deadline / disconnect / admin), the shared deadline
+resolution, per-tenant admission quotas with tenant-scoped Retry-After,
+weighted-fair cohort pick, cooperative cancellation races (before
+admission / between hops / after the final hop / against a tier-2
+cache hit), root-level `first:` early termination parity, and the
+DGRAPH_TPU_QOS=0 byte-identity contract end-to-end through
+DgraphServer with scheduler+cache+planner armed.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dgraph_tpu import obs
+from dgraph_tpu.models import PostingStore
+from dgraph_tpu.sched import (
+    CancelToken,
+    CohortScheduler,
+    QueryCancelledError,
+    SchedQuotaError,
+    SchedRequest,
+)
+from dgraph_tpu.sched import qos
+from dgraph_tpu.serve.server import DgraphServer
+from dgraph_tpu.utils.failpoints import fail
+from dgraph_tpu.utils.metrics import (
+    QUERY_CANCELLED,
+    TENANT_SHED,
+    LabeledHistogram,
+)
+
+
+def _parse(text):
+    from dgraph_tpu import gql
+
+    return gql.parse(text, None)
+
+
+def _post(addr, body, headers=None, timeout=60):
+    req = urllib.request.Request(
+        addr + "/query", data=body.encode(), method="POST",
+        headers=headers or {},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _get(addr, path, timeout=30):
+    with urllib.request.urlopen(addr + path, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+@pytest.fixture(autouse=True)
+def _recorder_reset():
+    yield
+    obs.configure()
+
+
+# --------------------------------------------------------------- token
+
+
+def test_cancel_token_first_reason_wins():
+    t = CancelToken(tenant="acme")
+    assert not t.cancelled
+    t.check()  # live: no raise
+    assert t.cancel("admin")
+    assert not t.cancel("disconnect")  # first reason sticks
+    assert t.reason == "admin"
+    with pytest.raises(QueryCancelledError) as ei:
+        t.check()
+    assert ei.value.reason == "admin"
+    assert ei.value.tenant == "acme"
+
+
+def test_cancel_token_deadline():
+    # zero budget = already spent
+    t = CancelToken(timeout_s=0.0)
+    with pytest.raises(QueryCancelledError) as ei:
+        t.check()
+    assert ei.value.reason == "deadline"
+    # a real (tiny) budget lapses
+    t2 = CancelToken(timeout_s=0.02)
+    t2.check()  # still inside the budget
+    time.sleep(0.03)
+    with pytest.raises(QueryCancelledError) as ei2:
+        t2.check()
+    assert ei2.value.reason == "deadline"
+    # negative budget behaves like zero
+    with pytest.raises(QueryCancelledError):
+        CancelToken(timeout_s=-5.0).check()
+
+
+def test_cancel_token_probe_rate_limited_and_disconnect():
+    calls = []
+
+    def probe():
+        calls.append(1)
+        return len(calls) >= 3  # "gone" on the third probe
+
+    t = CancelToken()
+    t.attach_probe(probe, interval_s=0.02)
+    t.check()  # probe 1 (first check always probes)
+    t.check()  # rate-limited: no probe
+    assert len(calls) == 1
+    time.sleep(0.025)
+    t.check()  # probe 2 (still connected)
+    time.sleep(0.025)
+    with pytest.raises(QueryCancelledError) as ei:
+        t.check()  # probe 3 → disconnect
+    assert ei.value.reason == "disconnect"
+    assert len(calls) == 3
+
+
+def test_cancel_token_broken_probe_is_counted_not_fatal():
+    def boom():
+        raise OSError("probe exploded")
+
+    t = CancelToken()
+    t.attach_probe(boom, interval_s=0.0)
+    t.check()  # swallowed (note_swallowed), query lives
+    assert not t.cancelled
+
+
+# ------------------------------------------------------------ deadlines
+
+
+@pytest.mark.parametrize("raw,want", [
+    (None, None),
+    ("", None),
+    ("garbage", None),
+    ("nan", None),
+    ("inf", None),
+    ("0", 0.0),
+    ("-3", 0.0),
+    ("1.5", 1.5),
+])
+def test_parse_timeout_contract(raw, want):
+    assert qos.parse_timeout(raw) == want
+
+
+def test_grpc_timeout_contract():
+    class Ctx:
+        def __init__(self, v):
+            self.v = v
+
+        def time_remaining(self):
+            if isinstance(self.v, Exception):
+                raise self.v
+            return self.v
+
+    assert qos.grpc_timeout(Ctx(None)) is None
+    assert qos.grpc_timeout(Ctx(2e8)) is None      # grpcio's no-deadline
+    assert qos.grpc_timeout(Ctx(RuntimeError())) is None
+    assert qos.grpc_timeout(Ctx(1.25)) == 1.25
+    assert qos.grpc_timeout(Ctx(-0.5)) == 0.0      # lapsed in transit
+
+
+# ------------------------------------------------------------ fair pick
+
+
+def test_drr_picker_proportional_and_deterministic():
+    a, b = qos.DrrPicker(), qos.DrrPicker()
+    weights = {"big": 3.0, "small": 1.0}
+    seq_a = [a.pick(weights) for _ in range(400)]
+    seq_b = [b.pick(weights) for _ in range(400)]
+    assert seq_a == seq_b  # deterministic
+    assert seq_a.count("big") == 300
+    assert seq_a.count("small") == 100
+    # a departing tenant stops competing; survivors take every slot
+    assert all(a.pick({"small": 1.0}) == "small" for _ in range(5))
+
+
+def test_tenant_config_from_env(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_QOS_TENANTS", json.dumps({
+        "gold": {"weight": 8, "max_queued": 64, "max_inflight": 4,
+                 "priority": "interactive"},
+        "scraper": {"weight": 1, "max_queued": 4},
+    }))
+    cfg = qos.QosConfig.from_env()
+    g = cfg.tenant("gold")
+    assert (g.weight, g.max_queued, g.max_inflight, g.priority) == (
+        8.0, 64, 4, "interactive"
+    )
+    assert cfg.tenant("scraper").max_queued == 4
+    # unconfigured tenants inherit defaults (weight 1, no quota)
+    anon = cfg.tenant("walk-in")
+    assert (anon.weight, anon.max_queued, anon.max_inflight) == (1.0, 0, 0)
+    # malformed JSON degrades to defaults-only, never refuses boot
+    monkeypatch.setenv("DGRAPH_TPU_QOS_TENANTS", "{not json")
+    cfg2 = qos.QosConfig.from_env()
+    assert cfg2.tenant("gold").weight == 1.0
+
+
+# ----------------------------------------------------------- scheduler
+
+SEED = """
+mutation { schema {
+  name: string @index(exact) .
+  age: int @index(int) .
+  friend: uid .
+} set {
+  <0x1> <name> "Ann" .  <0x1> <age> "31" .
+  <0x2> <name> "Ben" .  <0x2> <age> "29" .
+  <0x1> <friend> <0x2> .
+} }
+"""
+
+Q = '{ q(func: uid(0x1)) { name friend { name } } }'
+
+
+@pytest.fixture()
+def srv():
+    server = DgraphServer(PostingStore())
+    server.start()
+    _post(server.addr, SEED)
+    yield server
+    server.stop()
+
+
+def test_tenant_quota_http_429_with_scoped_retry_after(monkeypatch):
+    monkeypatch.setenv("DGRAPH_TPU_QOS_TENANTS", json.dumps({
+        "scraper": {"weight": 1, "max_queued": 1},
+    }))
+    server = DgraphServer(PostingStore())
+    server.start()
+    try:
+        _post(server.addr, SEED)
+        before = TENANT_SHED.total(tenant="scraper", reason="quota")
+        server._engine_lock.acquire_write()  # wedge: requests must queue
+        try:
+            t = threading.Thread(
+                target=lambda: _post(
+                    server.addr, Q, headers={"X-Dgraph-Tenant": "scraper"}
+                ),
+            )
+            t.start()
+            # wait until the first scraper request is queued
+            for _ in range(300):
+                if server.scheduler._tenant_depth.get("scraper"):
+                    break
+                time.sleep(0.01)
+            assert server.scheduler._tenant_depth.get("scraper") == 1
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(server.addr, Q, headers={"X-Dgraph-Tenant": "scraper"})
+            assert ei.value.code == 429
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            body = json.loads(ei.value.read().decode())
+            assert body["tenant"] == "scraper"
+            # OTHER tenants still admit: the quota is scoped
+            t2 = threading.Thread(
+                target=lambda: _post(
+                    server.addr, Q, headers={"X-Dgraph-Tenant": "gold"}
+                ),
+            )
+            t2.start()
+            for _ in range(300):
+                if server.scheduler._tenant_depth.get("gold"):
+                    break
+                time.sleep(0.01)
+            assert server.scheduler._tenant_depth.get("gold") == 1
+        finally:
+            server._engine_lock.release_write()
+        t.join(timeout=30)
+        t2.join(timeout=30)
+        assert TENANT_SHED.total(tenant="scraper", reason="quota") == before + 1
+        # all bookkeeping drained
+        assert server.scheduler._depth == 0
+        assert server.scheduler._tenant_depth == {}
+    finally:
+        server.stop()
+
+
+def test_weighted_fair_choose_and_inflight_skip(srv, monkeypatch):
+    """_choose picks tenants in weight proportion among due cohorts and
+    skips tenants at their in-flight cap (driven directly — no racing
+    wall-clock)."""
+    monkeypatch.setenv("DGRAPH_TPU_QOS_TENANTS", json.dumps({
+        "big": {"weight": 3},
+        "small": {"weight": 1, "max_inflight": 1},
+    }))
+    # workers neutered: this scheduler is a data structure under test
+    monkeypatch.setattr(CohortScheduler, "_worker_loop", lambda self: None)
+    sched = CohortScheduler(srv, max_batch=1, flush_ms=60_000, queue_cap=999)
+    try:
+        parsed = _parse(Q)
+        sig = ("sig",)
+        from dgraph_tpu.sched import Cohort
+
+        def enqueue(tenant, n):
+            for i in range(n):
+                c = Cohort(sig + (tenant, i), tenant=tenant)
+                c.reqs = [SchedRequest(parsed, tenant=tenant)]
+                sched._queues[(tenant, sig + (tenant, i))] = c
+
+        enqueue("big", 40)
+        enqueue("small", 40)
+        picks = []
+        with sched._cond:
+            for _ in range(40):  # every cohort is "full" (max_batch=1)
+                key, reason = sched._due_cohort(time.monotonic())
+                assert reason == "full"
+                picks.append(key[0])
+                sched._queues.pop(key)
+        assert picks.count("big") == 30
+        assert picks.count("small") == 10
+        # small at its in-flight cap: only big is pickable
+        sched._tenant_inflight["small"] = 1
+        with sched._cond:
+            for _ in range(10):
+                key, _ = sched._due_cohort(time.monotonic())
+                assert key[0] == "big"
+                sched._queues.pop(key)
+    finally:
+        sched.stop()
+
+
+def test_inflight_reserved_at_pop_not_at_flush(srv, monkeypatch):
+    """Regression (review): the in-flight reservation must happen in
+    the SAME lock hold as the pick — two workers popping same-tenant
+    cohorts back-to-back would otherwise both see stale inflight and
+    grant the tenant workers×cap concurrency."""
+    monkeypatch.setenv("DGRAPH_TPU_QOS_TENANTS", json.dumps({
+        "capped": {"max_inflight": 1},
+    }))
+    monkeypatch.setattr(CohortScheduler, "_worker_loop", lambda self: None)
+    sched = CohortScheduler(srv, max_batch=1, flush_ms=60_000, queue_cap=99)
+    try:
+        from dgraph_tpu.sched import Cohort
+
+        parsed = _parse(Q)
+        for i in range(2):
+            c = Cohort(("s", i), tenant="capped")
+            c.reqs = [SchedRequest(parsed, tenant="capped")]
+            sched._queues[("capped", ("s", i))] = c
+        cohort, reason = sched._next_cohort()
+        assert reason == "full" and cohort.tenant == "capped"
+        # the slot is reserved the instant the cohort left the queue...
+        assert sched._tenant_inflight.get("capped") == 1
+        # ...so the second due cohort is NOT pickable by another worker
+        with sched._cond:
+            assert sched._due_cohort(time.monotonic()) is None
+        # release unblocks it
+        with sched._cond:
+            sched._release_inflight("capped", 1)
+            assert sched._due_cohort(time.monotonic()) is not None
+    finally:
+        sched.stop()
+
+
+def test_cancel_registry_reregistered_trace_id_survives_eviction():
+    """Regression (review): a client retrying with the SAME trace id
+    re-registers it; stale eviction-queue entries must not evict the
+    live token, even at the capacity bound."""
+    reg = qos.CancelRegistry()
+    stale, live = CancelToken(), CancelToken()
+    reg.register("tid", stale)
+    reg.unregister("tid")
+    reg.register("tid", live)
+    # push the registry to its bound: the stale ("tid", stale) entry
+    # gets evicted first and must NOT take the live token with it
+    for i in range(qos.CancelRegistry._MAX - 1):
+        reg.register(f"other-{i}", CancelToken())
+    assert reg.cancel("tid")
+    assert live.cancelled and not stale.cancelled
+
+
+def test_cancel_registry_unregister_is_identity_checked():
+    """Regression (review): two sampled queries may share one trace id
+    — the first to finish must not unregister the other's live token."""
+    reg = qos.CancelRegistry()
+    a, b = CancelToken(), CancelToken()
+    reg.register("shared", a)
+    reg.register("shared", b)   # b overwrites: latest registration wins
+    reg.unregister("shared", a)  # a finishes: must NOT evict b
+    assert reg.cancel("shared")
+    assert b.cancelled and not a.cancelled
+    reg.unregister("shared", b)
+    assert not reg.cancel("shared")
+
+
+def test_admin_cancel_404s_for_inline_mutation_path(srv):
+    """Regression (review): the inline (mutation) path has no
+    cancellation checkpoints, so its trace id must NOT be registered —
+    /admin/cancel answering 200 there would claim a cancel it cannot
+    deliver."""
+    obs.configure(ratio=1e-9)
+    tp = "00-%032x-%016x-01" % (0x71, 0x71)
+    _post(srv.addr, 'mutation { set { <0x9> <name> "Zed" . } }',
+          headers={"Traceparent": tp})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            srv.addr + "/admin/cancel?trace_id=%032x" % 0x71, timeout=10
+        )
+    assert ei.value.code == 404
+
+
+def test_cancel_before_admission_leaks_nothing(srv):
+    tok = CancelToken()
+    tok.cancel("admin")
+    with pytest.raises(QueryCancelledError):
+        srv.scheduler.run(_parse(Q), tenant="t", cancel=tok)
+    assert srv.scheduler._depth == 0
+    assert srv.scheduler._tenant_depth.get("t") is None
+
+
+def test_cancel_concurrent_with_result_cache_hit(srv):
+    """A cancelled token wins over a warm tier-2 hit (no work either
+    way), and the same key still serves non-cancelled repeats."""
+    sched = srv.scheduler
+    if sched.result_cache is None:
+        pytest.skip("result cache off in this environment")
+    key = (Q, "", False)
+    out1, _ = sched.run(_parse(Q), key=key, tenant="t")
+    tok = CancelToken()
+    tok.cancel("admin")
+    with pytest.raises(QueryCancelledError):
+        sched.run(_parse(Q), key=key, tenant="t", cancel=tok)
+    out2, _ = sched.run(_parse(Q), key=key, tenant="t")
+    assert out1 == out2
+    assert sched._depth == 0
+
+
+def test_cancel_after_final_hop_is_a_noop(srv):
+    """A token flipped after execution completed changes nothing: the
+    response was already dealt, and the trace registration is gone."""
+    obs.configure(ratio=1e-9)
+    tp = "00-%032x-%016x-01" % (0x51, 0x51)
+    out = _post(srv.addr, Q, headers={"Traceparent": tp})
+    assert out["q"][0]["name"] == "Ann"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            srv.addr + "/admin/cancel?trace_id=%032x" % 0x51, timeout=10
+        )
+    assert ei.value.code == 404  # no live query: nothing to cancel
+
+
+# ------------------------------------------- mid-flight cancellation
+
+
+def _post_async(addr, body, headers, res):
+    try:
+        res["out"] = _post(addr, body, headers=headers)
+    except urllib.error.HTTPError as e:
+        res["code"] = e.code
+        res["body"] = json.loads(e.read().decode())
+    except Exception as e:  # pragma: no cover
+        res["err"] = e
+
+
+CHAIN_SEED = """
+mutation { schema { friend: uid . name: string . } set {
+  <0x1> <friend> <0x2> . <0x2> <friend> <0x3> .
+  <0x3> <friend> <0x4> . <0x4> <friend> <0x5> .
+  <0x5> <name> "end" .
+} }
+"""
+
+CHAIN_Q = (
+    "{ q(func: uid(0x1)) "
+    "{ friend { friend { friend { friend { name } } } } } }"
+)
+
+
+def _cancel_via_admin(addr, tid, deadline_s=10.0):
+    """Poll /admin/cancel until the registry has the token (bounded)."""
+    stop = time.monotonic() + deadline_s
+    while time.monotonic() < stop:
+        try:
+            with urllib.request.urlopen(
+                addr + "/admin/cancel?trace_id=" + tid, timeout=5
+            ):
+                return True
+        except urllib.error.HTTPError:
+            time.sleep(0.02)
+    return False
+
+
+def test_admin_cancel_mid_flight_stops_hop_dispatch():
+    """Acceptance: arm a slow-hop failpoint, cancel mid-flight, assert
+    the engine dispatched no further hops and the metric recorded the
+    right reason/tenant."""
+    obs.configure(ratio=1e-9)
+    server = DgraphServer(PostingStore())
+    server.start()
+    try:
+        _post(server.addr, CHAIN_SEED)
+        before = QUERY_CANCELLED.total(reason="admin", tenant="batcher")
+        h0 = fail.hits("engine.hop")
+        fail.arm("engine.hop", "delay(ms=300)")
+        try:
+            tp = "00-%032x-%016x-01" % (0x61, 0x61)
+            res = {}
+            t = threading.Thread(
+                target=_post_async,
+                args=(server.addr, CHAIN_Q,
+                      {"Traceparent": tp, "X-Dgraph-Tenant": "batcher"},
+                      res),
+            )
+            t.start()
+            assert _cancel_via_admin(server.addr, "%032x" % 0x61)
+            t.join(timeout=60)
+        finally:
+            fail.disarm("engine.hop")
+        assert res.get("code") == 499, res
+        assert res["body"]["code"] == "ErrorQueryCancelled"
+        # the 4-hop chain stopped early: strictly fewer dispatches than
+        # the query needs (each armed hop stalls 300ms; the cancel
+        # landed within the first one or two)
+        assert fail.hits("engine.hop") - h0 < 4
+        assert QUERY_CANCELLED.total(
+            reason="admin", tenant="batcher"
+        ) == before + 1
+        # the trace closed with the cancelled outcome (poll: spans from
+        # the worker thread land asynchronously)
+        stop = time.monotonic() + 10
+        root = None
+        while time.monotonic() < stop:
+            t_ = _get(server.addr, "/debug/traces/%032x" % 0x61)
+            roots = [s for s in t_["spans"] if s["name"] == "query"]
+            if roots and roots[0]["attrs"].get("outcome") == "cancelled":
+                root = roots[0]
+                break
+            time.sleep(0.05)
+        assert root is not None, "query span never closed with outcome=cancelled"
+        assert root["attrs"]["tenant"] == "batcher"
+    finally:
+        server.stop()
+
+
+def test_deadline_bounds_execution_not_just_queueing():
+    """Satellite: X-Dgraph-Timeout used to be enforced only while
+    queued — a slow query now stops mid-execution at the next hop
+    checkpoint and answers 504."""
+    server = DgraphServer(PostingStore())
+    server.start()
+    try:
+        _post(server.addr, CHAIN_SEED)
+        before = QUERY_CANCELLED.total(reason="deadline", tenant="default")
+        h0 = fail.hits("engine.hop")
+        fail.arm("engine.hop", "delay(ms=250)")
+        try:
+            res = {}
+            _post_async(
+                server.addr, CHAIN_Q, {"X-Dgraph-Timeout": "0.4"}, res
+            )
+        finally:
+            fail.disarm("engine.hop")
+        assert res.get("code") == 504, res
+        assert res["body"]["code"] == "ErrorDeadlineExceeded"
+        assert fail.hits("engine.hop") - h0 < 4
+        assert QUERY_CANCELLED.total(
+            reason="deadline", tenant="default"
+        ) == before + 1
+    finally:
+        server.stop()
+
+
+def test_qos_off_deadline_keeps_legacy_queued_only_semantics(monkeypatch):
+    """The =0 contract includes cancellation: with QoS off a slow query
+    past its budget still runs to completion (the pre-PR behavior)."""
+    monkeypatch.setenv("DGRAPH_TPU_QOS", "0")
+    server = DgraphServer(PostingStore())
+    server.start()
+    try:
+        _post(server.addr, CHAIN_SEED)
+        fail.arm("engine.hop", "delay(ms=150)")
+        try:
+            out = _post(
+                server.addr, CHAIN_Q, headers={"X-Dgraph-Timeout": "0.3"}
+            )
+        finally:
+            fail.disarm("engine.hop")
+        # ran to completion despite the lapsed budget: legacy semantics
+        assert out["q"][0]["friend"][0]["friend"][0]["friend"][0][
+            "friend"
+        ] == [{"name": "end"}]
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------- first: early exit
+
+
+def _age_store(n=4000):
+    lines = [f'<0x{u:x}> <age> "{u % 97}" .' for u in range(1, n + 1)]
+    store = PostingStore()
+    from dgraph_tpu.query.engine import QueryEngine
+
+    eng = QueryEngine(store)
+    eng.run(
+        "mutation { schema { age: int @index(int) . } set { %s } }"
+        % "\n".join(lines)
+    )
+    return store
+
+
+FIRST_QS = [
+    "{ q(func: has(age), first: 3) @filter(ge(age, 50)) { age } }",
+    "{ q(func: has(age), first: 5, offset: 2) @filter(ge(age, 90)) { age } }",
+    "{ q(func: has(age), first: 4, after: 0x200) @filter(le(age, 40)) { age } }",
+    # order present: early exit must NOT engage; results still identical
+    "{ q(func: has(age), first: 3, orderdesc: age) @filter(ge(age, 10)) { age } }",
+]
+
+
+def test_first_early_exit_parity_and_engagement(monkeypatch):
+    from dgraph_tpu.query.engine import QueryEngine
+
+    store = _age_store()
+    monkeypatch.setenv("DGRAPH_TPU_QOS", "0")
+    eng_off = QueryEngine(store)
+    legacy = [eng_off.run(q) for q in FIRST_QS]
+    monkeypatch.setenv("DGRAPH_TPU_QOS", "1")
+    eng_on = QueryEngine(store)
+    exits = 0
+    for q, want in zip(FIRST_QS, legacy):
+        got = eng_on.run(q)
+        assert got == want, q  # byte-identical results
+        exits += eng_on.stats["first_early_exit"]
+    # the unordered first: queries stopped before filtering all 4000
+    # candidates at least once
+    assert exits >= 1
+
+
+def test_first_early_exit_unsatisfied_filter_matches(monkeypatch):
+    """A filter so selective the early exit never satisfies `first:`
+    must fall through to exactly the full result."""
+    from dgraph_tpu.query.engine import QueryEngine
+
+    store = _age_store()
+    q = "{ q(func: has(age), first: 10) @filter(ge(age, 96)) { age } }"
+    monkeypatch.setenv("DGRAPH_TPU_QOS", "0")
+    want = QueryEngine(store).run(q)
+    monkeypatch.setenv("DGRAPH_TPU_QOS", "1")
+    assert QueryEngine(store).run(q) == want
+
+
+# -------------------------------------------------------- byte identity
+
+PARITY_SEED = """
+mutation { schema {
+  name: string @index(exact) .
+  age: int @index(int) .
+  friend: uid @reverse @count .
+} set {
+  <0x1> <name> "Ann" .   <0x1> <age> "31" .
+  <0x2> <name> "Ben" .   <0x2> <age> "29" .
+  <0x3> <name> "Cara" .  <0x3> <age> "40" .
+  <0x4> <name> "Dan" .   <0x4> <age> "22" .
+  <0x1> <friend> <0x2> . <0x1> <friend> <0x3> .
+  <0x2> <friend> <0x3> . <0x3> <friend> <0x4> .
+} }
+"""
+
+PARITY_QS = [
+    '{ q(func: uid(0x1)) { name friend { name age } } }',
+    '{ q(func: eq(name, "Ann")) { name friend { name } } }',
+    '{ q(func: ge(age, 25), orderasc: age) { name age } }',
+    '{ q(func: has(age), first: 2) @filter(ge(age, 25)) { name } }',
+    '{ q(func: uid(0x3)) { c: count(friend) ~friend { name } } }',
+    '{ q(func: uid(0x1)) { friend @filter(ge(age, 30)) { name } } }',
+]
+
+
+def test_qos_off_and_absent_headers_byte_identical(monkeypatch):
+    """Acceptance: DGRAPH_TPU_QOS=0 — and QoS on with absent tenant
+    headers — serve byte-identical responses end-to-end through
+    DgraphServer with scheduler+cache+planner armed."""
+    def serve(qos_flag, headers=None):
+        monkeypatch.setenv("DGRAPH_TPU_QOS", qos_flag)
+        monkeypatch.setenv("DGRAPH_TPU_SCHED", "1")
+        monkeypatch.setenv("DGRAPH_TPU_CACHE", "1")
+        monkeypatch.setenv("DGRAPH_TPU_PLANNER", "1")
+        server = DgraphServer(PostingStore())
+        server.start()
+        try:
+            _post(server.addr, PARITY_SEED)
+            out = []
+            for q in PARITY_QS:
+                for _ in range(2):  # second pass exercises the caches
+                    r = _post(server.addr, q, headers=headers)
+                    r.pop("server_latency", None)
+                out.append(r)
+            return out
+        finally:
+            server.stop()
+
+    legacy = serve("0")
+    assert serve("1") == legacy                      # absent headers
+    assert serve("1", {"X-Dgraph-Tenant": "acme"}) == legacy  # named tenant
+
+
+# ------------------------------------------------------------- metrics
+
+
+def test_labeled_histogram_exposition_and_bounding():
+    lh = LabeledHistogram("t_seconds", "tenant", (0.1, 1.0), max_series=2)
+    lh.observe("a", 0.05)
+    lh.observe("b", 0.5)
+    lh.observe("c", 5.0)   # over the cap: lands in the overflow series
+    lh.observe("d", 5.0)
+    snap = lh.snapshot()
+    assert set(snap) == {"a", "b", "overflow"}
+    cum, s, c = snap["overflow"]
+    assert c == 2
+    from dgraph_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = reg.labeled_histogram("dgraph_t_seconds", "tenant", (0.1, 1.0))
+    h.observe("acme", 0.05)
+    text = reg.prometheus_text()
+    assert '# TYPE dgraph_t_seconds histogram' in text
+    assert 'dgraph_t_seconds_bucket{tenant="acme",le="0.1"} 1' in text
+    assert 'dgraph_t_seconds_count{tenant="acme"} 1' in text
+
+
+def test_tenant_shed_and_latency_series_on_server(monkeypatch, srv):
+    obs.configure(ratio=0.0)
+    _post(srv.addr, Q, headers={"X-Dgraph-Tenant": "series-check"})
+    with urllib.request.urlopen(
+        srv.addr + "/debug/prometheus_metrics", timeout=10
+    ) as r:
+        text = r.read().decode()
+    assert (
+        'dgraph_tenant_query_latency_seconds_count{tenant="series-check"}'
+        in text
+    )
