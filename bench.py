@@ -898,6 +898,322 @@ def run_qos_bench():
     return out
 
 
+def _ivm_mode(
+    ivm_on: bool, store, variants, clients: int, secs: float,
+    write_rate: float, write_pred: str, cache_on: bool = True,
+):
+    """One closed-loop read run with a paced writer beside it.
+
+    ``clients`` reader threads fire the zipf variant mix while ONE
+    writer toggles edges on ``write_pred`` at ``write_rate``/s (each
+    toggle is an add immediately followed by its delete, so the run
+    ends at the state it started — what makes the post-quiesce parity
+    probe meaningful).  Cache ON both arms; only DGRAPH_TPU_IVM flips:
+    the off arm is the store.version-keyed baseline every mutation
+    global-invalidates.  Returns (qps, completed, final_responses)."""
+    import json as _json
+    import threading
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("DGRAPH_TPU_SCHED", "DGRAPH_TPU_CACHE", "DGRAPH_TPU_IVM")
+    }
+    os.environ["DGRAPH_TPU_SCHED"] = "1"
+    os.environ["DGRAPH_TPU_CACHE"] = "1" if cache_on else "0"
+    os.environ["DGRAPH_TPU_IVM"] = "1" if ivm_on else "0"
+    from dgraph_tpu.serve.server import DgraphServer
+
+    srv = DgraphServer(store)
+    srv.start()
+    try:
+        import http.client
+
+        def mkconn():
+            return http.client.HTTPConnection(
+                "127.0.0.1", srv.port, timeout=30
+            )
+
+        def post_on(conn, q):
+            conn.request("POST", "/query", body=q.encode())
+            r = conn.getresponse()
+            body = r.read()
+            if r.status != 200:
+                raise RuntimeError(f"HTTP {r.status}: {body[:200]!r}")
+            return _json.loads(body.decode())
+
+        warm = mkconn()
+        for q in variants:
+            post_on(warm, q)
+
+        lat_lock = threading.Lock()
+        done = [0]
+        errs: list = []
+        stop_at = [0.0]
+        quiesce = threading.Event()
+
+        s = float(os.environ.get("BENCH_SERVE_ZIPF", 1.1))
+        w = 1.0 / np.power(
+            np.arange(1, len(variants) + 1, dtype=np.float64), s
+        )
+        probs = w / w.sum()
+
+        def reader(cid: int):
+            rng = np.random.default_rng(2000 + cid)  # same draw each arm
+            n = 0
+            conn = mkconn()
+            try:
+                while time.monotonic() < stop_at[0]:
+                    q = variants[int(rng.choice(len(variants), p=probs))]
+                    post_on(conn, q)
+                    n += 1
+            except Exception as e:
+                errs.append(e)
+            finally:
+                conn.close()
+            with lat_lock:
+                done[0] += n
+
+        def writer():
+            # paced edge toggles: add + revert, one WAL'd mutation each,
+            # single-edge journal deltas (the repair path's shape)
+            if write_rate <= 0:
+                return
+            conn = mkconn()
+            i = 0
+            try:
+                while time.monotonic() < stop_at[0]:
+                    u = 0x70000 + (i % 97)
+                    i += 1
+                    post_on(conn, "mutation { set { <0x%x> <%s> <0x%x> . } }"
+                            % (u, write_pred, u + 1))
+                    post_on(conn, "mutation { delete { <0x%x> <%s> <0x%x> . } }"
+                            % (u, write_pred, u + 1))
+                    time.sleep(1.0 / write_rate)
+            except Exception as e:
+                if not quiesce.is_set():
+                    errs.append(e)
+            finally:
+                conn.close()
+
+        ts = [
+            threading.Thread(target=reader, args=(c,), daemon=True)
+            for c in range(clients)
+        ]
+        wt = threading.Thread(target=writer, daemon=True)
+        stop_at[0] = time.monotonic() + secs
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        wt.start()
+        for t in ts:
+            t.join(timeout=secs + 60)
+        wall = time.monotonic() - t0
+        quiesce.set()
+        wt.join(timeout=secs + 60)
+        if errs:
+            raise errs[0]
+        # post-quiesce probe: the writer reverted every toggle, so a
+        # correctly-invalidated (or correctly-REPAIRED) cache must now
+        # answer exactly the initial state — through the warm cache
+        final = {}
+        conn = mkconn()
+        for q in variants:
+            out = post_on(conn, q)
+            out.pop("server_latency", None)
+            final[q] = out
+        conn.close()
+        return done[0] / wall, done[0], final
+    finally:
+        srv.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _ivm_subscription_demo(store) -> dict:
+    """The live-query acceptance probe: a registered subscription gets
+    exactly ONE trace-linked push after an affecting mutation, and
+    nothing for an unrelated-predicate mutation."""
+    import json as _json
+    import urllib.request
+
+    from dgraph_tpu import obs
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("DGRAPH_TPU_SCHED", "DGRAPH_TPU_CACHE", "DGRAPH_TPU_IVM")
+    }
+    os.environ["DGRAPH_TPU_SCHED"] = "1"
+    os.environ["DGRAPH_TPU_CACHE"] = "1"
+    os.environ["DGRAPH_TPU_IVM"] = "1"
+    rec = obs.configure(ratio=1.0, seed=11)  # every eval traced
+    from dgraph_tpu.serve.server import DgraphServer
+
+    srv = DgraphServer(store)
+    srv.start()
+    try:
+        base = srv.addr
+
+        def post(path, body):
+            return urllib.request.urlopen(
+                urllib.request.Request(base + path, data=body.encode()),
+                timeout=15,
+            )
+
+        reg = _json.load(post(
+            "/subscribe", "{ s(func: uid(0x1)) { e { c: count(e) } } }"
+        ))
+        sid = reg["sub_id"]
+        sub = srv.subs.get(sid)
+        ev0 = sub.next_event(timeout=10)  # the snapshot
+        assert ev0 and ev0["kind"] == "snapshot", ev0
+        # unrelated predicate: NO push
+        post("/query", 'mutation { set { <0x9999> <unrelated_w> "x" . } }')
+        quiet = sub.next_event(timeout=1.0)
+        assert quiet is None, f"unrelated mutation pushed: {quiet}"
+        # affecting predicate: exactly one push, trace-linked
+        post("/query", "mutation { set { <0x1> <e> <0x2> . } }")
+        ev = sub.next_event(timeout=10)
+        assert ev is not None and ev["kind"] == "update", ev
+        assert ev["trace_id"], "push was not trace-linked"
+        tr = rec.trace(ev["trace_id"])
+        assert tr is not None and any(
+            s["name"] == "subs.eval" for s in tr["spans"]
+        ), "push trace_id does not resolve to a subs.eval trace"
+        post("/subscribe/cancel?id=" + sid, "")
+        # revert so later arms see the initial graph
+        post("/query", "mutation { delete { <0x1> <e> <0x2> . } }")
+        return {
+            "pushed_seq": ev["seq"],
+            "trigger_preds": ev["preds"],
+            "trace_linked": True,
+            "unrelated_pushed_nothing": True,
+        }
+    finally:
+        srv.stop()
+        obs.configure(ratio=0.0)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_ivm_bench():
+    """Write-rate sweep (ISSUE 12): QPS of the warm two-tier cache as a
+    paced writer runs beside the readers — predicate-scoped
+    invalidation + delta repair (DGRAPH_TPU_IVM=1, default) against the
+    ``store.version``-keyed baseline (=0) where ANY write invalidates
+    EVERY cached hop and response.  Writers toggle an UNRELATED
+    predicate (the production shape: writes spread across predicates,
+    reads concentrate) plus a hot-predicate row that must engage the
+    delta-REPAIR path; both assert post-quiesce parity against the
+    initial canonical responses, and the subscription demo asserts the
+    live-query push contract.  Returns the dict published under "ivm"
+    in the headline JSON."""
+    from statistics import median
+
+    from dgraph_tpu.utils.metrics import IVM_REPAIRS, QCACHE_RESULT_EVENTS
+
+    clients = int(os.environ.get("BENCH_IVM_CLIENTS", 12))
+    secs = float(os.environ.get("BENCH_IVM_SECONDS", 3.0))
+    n_nodes = int(os.environ.get("BENCH_IVM_NODES", 8_000))
+    deg = int(os.environ.get("BENCH_IVM_DEG", 12))
+    rates = [
+        float(x)
+        for x in os.environ.get("BENCH_IVM_WRITE_RATES", "0,25").split(",")
+    ]
+    reps = max(1, int(os.environ.get("BENCH_IVM_REPS", 2)))
+    store = _serving_store(n_nodes, deg)
+
+    rng = np.random.default_rng(17)
+    variants = []
+    for _ in range(32):
+        seeds = np.unique(rng.integers(1, n_nodes + 1, size=8))
+        ul = ", ".join("0x%x" % u for u in seeds)
+        variants.append("{ q(func: uid(%s)) { e { c: count(e) } } }" % ul)
+
+    # canonical truth: cache OFF (cache_on=False — the ground truth
+    # must come from the cache-less execution path, or a deterministic
+    # staleness bug could corrupt canon and probe identically), no
+    # writer (the writer always reverts, so every post-quiesce probe
+    # must reproduce these bytes)
+    _q, _n, canon = _ivm_mode(
+        True, store, variants, clients=2, secs=0.3, write_rate=0,
+        write_pred="unrelated_w", cache_on=False,
+    )
+
+    sweep = []
+    for rate in rates:
+        on_runs, off_runs = [], []
+        for _ in range(reps):
+            qps, _n, fin = _ivm_mode(
+                True, store, variants, clients, secs, rate, "unrelated_w"
+            )
+            assert fin == canon, (
+                f"IVM-on arm diverged after quiesce at rate {rate}"
+            )
+            on_runs.append(qps)
+            qps, _n, fin = _ivm_mode(
+                False, store, variants, clients, secs, rate, "unrelated_w"
+            )
+            assert fin == canon, (
+                f"baseline arm diverged after quiesce at rate {rate}"
+            )
+            off_runs.append(qps)
+        qps_on = median(on_runs)
+        qps_off = median(off_runs)
+        sweep.append({
+            "write_rate": rate,
+            "qps_ivm_on": round(qps_on, 1),
+            "qps_ivm_off": round(qps_off, 1),
+            "ratio": round(qps_on / qps_off, 3) if qps_off else None,
+        })
+
+    # hot-predicate row: writes hit the READ predicate, so the win must
+    # come from the delta-REPAIR path keeping hop entries warm — assert
+    # it actually engaged
+    hot_rate = float(os.environ.get("BENCH_IVM_HOT_RATE", "25"))
+    rep0 = IVM_REPAIRS.snapshot()
+    t2_0 = QCACHE_RESULT_EVENTS.snapshot()
+    hot_qps, _n, fin = _ivm_mode(
+        True, store, variants, clients, secs, hot_rate, "e"
+    )
+    assert fin == canon, "hot-write IVM arm diverged after quiesce"
+    rep1 = IVM_REPAIRS.snapshot()
+    hop_repaired = (
+        rep1.get(("hop", "repaired"), 0) - rep0.get(("hop", "repaired"), 0)
+    )
+    assert hop_repaired > 0, (
+        "hot-write arm never engaged the hop repair path"
+    )
+    t2_1 = QCACHE_RESULT_EVENTS.snapshot()
+
+    nz = [row for row in sweep if row["write_rate"] > 0]
+    headline = nz[-1]["ratio"] if nz else None
+    return {
+        "clients": clients,
+        "seconds": secs,
+        "reps": reps,
+        "qps_vs_write_rate": sweep,
+        # the ISSUE 12 headline: warm-cache QPS under writes, scoped
+        # invalidation over the global-version baseline
+        "write_rate_qps_ratio": headline,
+        "hot_write": {
+            "write_rate": hot_rate,
+            "qps": round(hot_qps, 1),
+            "hop_entries_repaired": hop_repaired,
+            "tier2_events": {
+                k: t2_1.get(k, 0) - t2_0.get(k, 0) for k in t2_1
+            },
+        },
+        "subscription": _ivm_subscription_demo(store),
+        "parity_asserted": True,
+    }
+
+
 def _mutation_mode(
     group_commit: bool, clients: int, secs: float, tmp: str,
     fsync_ms: float = 0.0,
@@ -1189,6 +1505,15 @@ def run_bench(scale: float):
             qos_arm = run_qos_bench()
         except Exception as e:
             qos_arm = {"error": f"{type(e).__name__}: {e}"}
+    ivm_arm = None
+    if os.environ.get("BENCH_IVM", "1") != "0":
+        # write-rate sweep (ISSUE 12): warm-cache QPS under a paced
+        # writer, predicate-scoped invalidation + delta repair vs the
+        # store.version-keyed baseline; same isolation contract
+        try:
+            ivm_arm = run_ivm_bench()
+        except Exception as e:
+            ivm_arm = {"error": f"{type(e).__name__}: {e}"}
     # planner honesty row: every route decision this process made (the
     # serving arms run in-process) with the measured mispredict rate —
     # future bench rounds show route choice alongside throughput, and a
@@ -1221,6 +1546,11 @@ def run_bench(scale: float):
                 # skips; BENCH_QOS_* size it) — victim p99 bounded with
                 # QoS on, the leak shown with QoS off
                 "qos": qos_arm,
+                # IVM write-rate sweep (BENCH_IVM=0 skips; BENCH_IVM_*
+                # size it) — QPS-vs-write-rate curve, scoped
+                # invalidation over the global-version baseline, repair
+                # engagement + live-query push demo
+                "ivm": ivm_arm,
                 # measured-cost planner (PR 10): per-route decision
                 # counts + mispredict rate + the calibrated rates that
                 # drove this run's routing
@@ -1254,6 +1584,11 @@ def main():
         # without paying for the headline traversal bench — the job
         # exists so the harness itself cannot rot
         print(json.dumps({"qos": run_qos_bench(), "platform": platform}))
+        return
+    if os.environ.get("BENCH_ONLY") == "ivm":
+        # standalone IVM smoke (CI): the write-rate sweep + live-query
+        # push demo at tiny sizes — same rot-guard contract as qos
+        print(json.dumps({"ivm": run_ivm_bench(), "platform": platform}))
         return
     scale = float(os.environ.get("BENCH_SCALE", 1.0))
     try:

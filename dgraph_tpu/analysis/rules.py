@@ -798,6 +798,65 @@ class NakedRouteThreshold(Rule):
                         break
 
 
+# -- rule: naked-version-key --------------------------------------------------
+
+def _storeish(node: ast.AST) -> bool:
+    """Does this expression read like a store reference (``store``,
+    ``self.store``, ``self._server.store``, ``self.engine.store``)?"""
+    d = _dotted(node)
+    return d == "store" or d.endswith(".store")
+
+
+class NakedVersionKey(Rule):
+    id = "naked-version-key"
+    doc = (
+        "bare store.version read in the cache-keying layers — "
+        "predicate-scoped cache versions live in dgraph_tpu/ivm/"
+        "versions.py (hop_version/result_version/version_for); a new "
+        "view keyed on the GLOBAL version quietly regrows one-write-"
+        "invalidates-everything"
+    )
+
+    # the layers that construct cache keys / freshness probes; the ivm/
+    # package is the sanctioned home and sits outside them by design.
+    # Both spellings are flagged: a plain ``<x>.store.version``
+    # attribute read and the duck-typed ``getattr(<store>, "version")``.
+    _DIRS = ("cache/", "query/", "sched/", "serve/")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if not any(d in path for d in self._DIRS):
+            return
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr == "version"
+                and _storeish(node.value)
+            ):
+                yield ctx.finding(
+                    self.id, node,
+                    "bare store.version read: key caches through "
+                    "dgraph_tpu/ivm/versions.py (predicate-scoped "
+                    "freshness) — or pragma the site with WHY it is "
+                    "not a cache key",
+                )
+            elif isinstance(node, ast.Call):
+                if (
+                    _dotted(node.func) == "getattr"
+                    and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Constant)
+                    and node.args[1].value == "version"
+                    and _storeish(node.args[0])
+                ):
+                    yield ctx.finding(
+                        self.id, node,
+                        "bare getattr(store, \"version\") read: key "
+                        "caches through dgraph_tpu/ivm/versions.py "
+                        "(predicate-scoped freshness) — or pragma the "
+                        "site with WHY it is not a cache key",
+                    )
+
+
 # -- rule: unchecked-hop-loop -----------------------------------------------
 
 # the expander/dispatch seam: calls that (directly or one wrapper deep)
@@ -884,5 +943,6 @@ ALL_RULES: Tuple[Rule, ...] = (
     NakedAtomicWrite(),
     NakedStageTiming(),
     NakedRouteThreshold(),
+    NakedVersionKey(),
     UncheckedHopLoop(),
 )

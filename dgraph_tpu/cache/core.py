@@ -7,12 +7,14 @@ query *services*: under concurrent skewed workloads, cross-query reuse
 of intermediate results dominates served QPS.  This module supplies the
 one mechanism both cache tiers (cache/hop.py, cache/result.py) share:
 
-- **Snapshot versioning.**  Every entry is keyed under the store's
-  monotonic mutation ``version`` (models/store.py — bumped by every
-  mutation batch, PR 2's admission-signature primitive).  A probe
-  carries the *current* version; an entry recorded under any older
-  version can never match, so a mutation is a global, O(1)
-  invalidation: no flush stall, no lockstep with writers.
+- **Snapshot versioning.**  Every entry is keyed under a caller-chosen
+  monotonic version — since IVM (dgraph_tpu/ivm/versions.py) the
+  footprint-scoped predicate version, the store's global mutation
+  ``version`` before it / under ``DGRAPH_TPU_IVM=0``.  A probe carries
+  the *current* version; an entry recorded under any older version can
+  never match, so invalidation is O(1): no flush stall, no lockstep
+  with writers.  ``repair_where`` additionally lets the IVM layer
+  transform-and-re-key entries a delta can fix in place.
 
 - **Generation sweeping.**  Dead-version entries still occupy budget
   until reclaimed.  Rather than a stop-the-world flush (a latency
@@ -179,6 +181,44 @@ class VersionedLFUCache:
             for _ in range(evicted):
                 hook("evicted", None)
         return True
+
+    def repair_where(
+        self,
+        pred: Callable[[object], bool],
+        old_version: int,
+        new_version: int,
+        fix: Callable,
+    ) -> Tuple[int, int]:
+        """IVM delta repair (dgraph_tpu/ivm/): for every entry whose KEY
+        satisfies ``pred``, entries recorded at exactly ``old_version``
+        are transformed by ``fix(value) -> (new_value, nbytes) | None``
+        and RE-KEYED to ``new_version`` (heat and age preserved — the
+        repaired entry IS the same logical entry); entries at any other
+        version, and entries ``fix`` declines, are dropped.  Returns
+        (repaired, dropped).
+
+        ``fix`` runs under the tier lock — callers gate repair to small
+        deltas (query/planner.py repair_route), so the hold is bounded
+        the same way the eviction scan is."""
+        repaired = dropped = 0
+        with self._lock:
+            for k in [k for k in self._m if pred(k)]:
+                e = self._m[k]
+                out = None
+                if e.version == old_version:
+                    out = fix(e.value)
+                if out is None:
+                    del self._m[k]
+                    self._bytes -= e.nbytes
+                    dropped += 1
+                    continue
+                value, nbytes = out
+                self._bytes += int(nbytes) - e.nbytes
+                e.value = value
+                e.nbytes = int(nbytes)
+                e.version = new_version
+                repaired += 1
+        return repaired, dropped
 
     def drop_where(self, pred: Callable[[object], bool]) -> int:
         """Remove every entry whose KEY satisfies ``pred`` (explicit

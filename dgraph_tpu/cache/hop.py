@@ -5,12 +5,21 @@ frontier) → (out_flat, seg_ptr)`` — is deterministic over an immutable
 arena snapshot (the property the cohort HopMerger already relies on to
 deal union expansions back byte-identically, sched/cohort.py).  That
 makes it memoizable: key the call by ``(arena identity, predicate,
-direction, frontier digest, store version)`` and a repeat hop under an
-unchanged store returns the SAME arrays with zero device work — no
-dispatch, no transport round trip, no compile-cache probe.  Under PR
-2's zipf serving workload the head queries re-execute the same hops
+direction, frontier digest, predicate version)`` and a repeat hop under
+an unchanged PREDICATE returns the SAME arrays with zero device work —
+no dispatch, no transport round trip, no compile-cache probe.  Under
+PR 2's zipf serving workload the head queries re-execute the same hops
 thousands of times against an unchanged store; this tier converts each
 of those re-executions into a dict probe.
+
+IVM (dgraph_tpu/ivm/): the version in the key is the PREDICATE's
+last-mutation version (ivm/versions.py::hop_version — the global
+``store.version`` under ``DGRAPH_TPU_IVM=0``), so writes to other
+predicates never touch this tier's entries; and a small delta to the
+entry's own predicate REPAIRS it in place (``repair_pred`` below,
+driven by ``ArenaManager._try_apply_delta`` under the planner's
+repair-vs-rebuild gate) instead of dropping it — the entry carries its
+frontier for exactly this purpose.
 
 A hit must short-circuit BEFORE dispatch so the existing compile-count
 guards hold (a cached hop adds zero programs by construction).
@@ -131,7 +140,7 @@ class HopCache:
             return None
         value, age = hit
         QCACHE_HIT_AGE.observe(age)
-        return value
+        return value[0], value[1]
 
     def put(
         self,
@@ -146,10 +155,60 @@ class HopCache:
     ) -> None:
         if key is None:
             key = self.key_for(arena, attr, reverse, src)
-        nbytes = int(out.nbytes) + int(seg_ptr.nbytes) + 64
-        self._c.put(key, version, (out, seg_ptr), nbytes)
+        # the FRONTIER rides in the entry beside the expansion: delta
+        # repair (repair_pred below) must know which rows an edge delta
+        # touches, and the digest in the key is one-way.  Its bytes are
+        # charged to the budget like the payload's.
+        frontier = np.ascontiguousarray(src, dtype=np.int64)
+        nbytes = (
+            int(out.nbytes) + int(seg_ptr.nbytes) + int(frontier.nbytes) + 64
+        )
+        self._c.put(key, version, (out, seg_ptr, frontier), nbytes)
         # admissions and sweeps change occupancy without a get-event
         QCACHE_HOP_BYTES.set(self._c.occupancy_bytes)
+
+    # -- delta repair (dgraph_tpu/ivm/) --------------------------------------
+
+    def repair_pred(
+        self,
+        arena_id: int,
+        attr: str,
+        reverse: bool,
+        adds: np.ndarray,
+        dels: np.ndarray,
+        old_version: int,
+        new_version: int,
+    ):
+        """Apply a predicate's edge deltas to every cached entry for
+        ``(arena_id, attr, reverse)`` recorded at ``old_version``,
+        re-keying survivors to ``new_version`` — entries the delta
+        cannot repair (or that sit at any other version) drop.  Called
+        from ``ArenaManager._try_apply_delta`` after the arena's own
+        host mirrors were updated, under the repair cost gate
+        (query/planner.py).  Returns (repaired, dropped)."""
+        from dgraph_tpu.ivm.repair import repair_hop_entry
+
+        def fix(value):
+            out, seg_ptr, frontier = value
+            fixed = repair_hop_entry(out, seg_ptr, frontier, adds, dels)
+            if fixed is None:
+                return None
+            out2, seg2 = fixed
+            nbytes = (
+                int(out2.nbytes) + int(seg2.nbytes)
+                + int(frontier.nbytes) + 64
+            )
+            return (out2, seg2, frontier), nbytes
+
+        res = self._c.repair_where(
+            lambda k: k[0] == arena_id and k[1] == attr
+            and k[2] == bool(reverse),
+            old_version,
+            new_version,
+            fix,
+        )
+        QCACHE_HOP_BYTES.set(self._c.occupancy_bytes)
+        return res
 
     # -- invalidation --------------------------------------------------------
 

@@ -16,9 +16,14 @@ handlers only encode results, never mutate them.  Responses that
 depend on wall-clock (``math(since(...))``) are detected at parse
 shape and never cached.
 
-Invalidation is the shared snapshot-version scheme (cache/core.py):
-every mutation bumps ``store.version``; entries under older versions
-die logically at the bump and are reclaimed by the incremental sweep.
+Invalidation is the shared snapshot-version scheme (cache/core.py),
+SCOPED since IVM (dgraph_tpu/ivm/): the scheduler keys each entry on
+the max last-mutation version over the request's referenced-predicate
+footprint (ivm/versions.py::result_version; the global
+``store.version`` when the footprint is unknowable or under
+``DGRAPH_TPU_IVM=0``), so a mutation only kills the responses that
+actually read its predicates; stale entries die logically at the
+version advance and are reclaimed by the incremental sweep.
 
 Knobs: ``DGRAPH_TPU_CACHE`` (shared gate),
 ``DGRAPH_TPU_CACHE_RESULT_BYTES`` (budget, default 32 MiB, 0 disables

@@ -114,6 +114,12 @@ def bytes_to_state(data: bytes, store: PostingStore) -> None:
             raise ValueError("corrupt snapshot payload")
         apply_record(store, payload)
         pos = start + length
+    # full-store replacement: predicates absent from the snapshot kept
+    # their old per-pred versions above — only an IVM floor bump makes
+    # every footprint-keyed cache entry stale (ivm/versions.py)
+    note = getattr(store, "note_global_change", None)
+    if note is not None:
+        note()
 
 
 class ReplicatedGroup:

@@ -1,0 +1,57 @@
+"""dgraph_tpu.ivm — incremental view maintenance.
+
+The write-path half of the serving story.  Before this package, every
+derived view in the tree — both query-cache tiers, the arena-derived
+layouts, the PR-9 tile store — keyed its freshness on the store's
+GLOBAL mutation ``version``: one write anywhere invalidated every
+cached hop, every memoized response, and every warm tile block, so the
+cache tiers' measured QPS win evaporated exactly at the write rates a
+production deployment runs at (ROADMAP item 1).  Continuous Graph
+Processing (PAPERS.md) frames the fix as one mechanism with two
+customers: a mutation **delta stream** whose deltas both *repair*
+derived views in place and *push* re-evaluated results to standing
+queries.
+
+Three layers, all gated by ``DGRAPH_TPU_IVM`` (default on; ``0``
+restores the global-version keying byte-identically):
+
+- **Per-predicate versions** (models/store.py + :mod:`ivm.versions`) —
+  the store tracks, per predicate, the version of the last mutation
+  that touched it.  Cache entries key on the MAX version over the
+  predicates they actually read (the ``gql.ast.referenced_preds``
+  footprint for tier-2 responses, the single hop predicate for tier-1
+  entries), so a mutation only invalidates entries that reference its
+  predicates.  This module is the ONE sanctioned home of
+  ``store.version``-derived cache keys (graftlint:
+  ``naked-version-key``).
+- **Delta repair** (:mod:`ivm.repair` + models/arena.py +
+  ops/spgemm.py) — for the hot head, a small mutation batch is applied
+  to cached hop expansions and densified tile blocks IN PLACE instead
+  of dropping them (a tile delta is a scatter on one T×T block), behind
+  a repair-vs-rebuild cost gate in the PR-10 planner.
+- **Live queries** (:mod:`ivm.deltas` + :mod:`ivm.subs`) — the same
+  delta stream powers ``POST /subscribe``: registered queries re-run
+  when a predicate in their footprint mutates and PUSH the new result
+  (SSE / gRPC server-stream), cancellable via PR-11 ``CancelToken``,
+  quota-bounded per tenant, traced by the PR-7 flight recorder.
+
+docs/deploy.md "Incremental view maintenance" covers the knobs and the
+operator surface.
+"""
+
+from dgraph_tpu.ivm.deltas import DeltaStream, attach_stream
+from dgraph_tpu.ivm.versions import (
+    hop_version,
+    ivm_enabled,
+    result_version,
+    version_for,
+)
+
+__all__ = [
+    "DeltaStream",
+    "attach_stream",
+    "hop_version",
+    "ivm_enabled",
+    "result_version",
+    "version_for",
+]

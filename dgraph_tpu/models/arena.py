@@ -409,6 +409,18 @@ class CSRArena:
             self._apply_delta_locked(adds, dels)
 
     def _apply_delta_locked(self, adds: np.ndarray, dels: np.ndarray) -> None:
+        # degree-histogram repair (IVM satellite): capture the affected
+        # rows' PRE-delta degrees so the log2 buckets can be adjusted
+        # instead of dropped — the planner's skew inputs (joinplan's
+        # heavy-tail pad) otherwise cold-start on every point write
+        hist = getattr(self, "_deg_hist", None)
+        touched = None
+        if hist is not None:
+            touched = np.unique(np.concatenate([
+                np.asarray(a[:, 0], dtype=np.int64)
+                for a in (adds, dels) if len(a)
+            ])) if (len(adds) or len(dels)) else np.empty(0, np.int64)
+            old_degs = self._degrees_of_uids(touched)
         h_dst = self.host_dst().astype(np.int64, copy=False)
         # absolute edge positions via the composite (row, dst) key — the
         # CSR flat dst IS sorted by it
@@ -451,15 +463,72 @@ class CSRArena:
         self._inline = None
         self._inline_grouped = None
         self._lut = None
-        self._tiles = None
         self._n_distinct_dst = None
         for attr in (
             "_topm_cdeg", "_topm_ovdeg", "_topm_deg", "_classed",
-            "_tile_blocks", "_deg_hist",
+            "_tile_blocks",
         ):
             if hasattr(self, attr):
                 delattr(self, attr)
+        if hist is not None and touched is not None:
+            # move each affected row between its old and new log2 bucket
+            new_degs = self._degrees_of_uids(touched)
+            for od, nd in zip(old_degs.tolist(), new_degs.tolist()):
+                if od != nd:
+                    self._hist_move(od, nd)
+        # MXU tile repair (dgraph_tpu/ivm/): a small delta scatters onto
+        # the stored T×T blocks instead of dropping the densified layout
+        # wholesale — structurally-impossible repairs (new block, grown
+        # universe) and disabled modes fall back to the drop
+        pt = self._tiles
+        if pt is not None:
+            repaired = None
+            if len(adds) + len(dels) > 0 and _ivm_repair_gate(
+                len(adds) + len(dels), self.n_edges
+            ):
+                from dgraph_tpu.ops import spgemm as _spgemm
+                from dgraph_tpu.utils.metrics import (
+                    IVM_REPAIR_EDGES,
+                    IVM_REPAIRS,
+                )
+
+                repaired = _spgemm.apply_tile_delta(pt, adds, dels)
+                IVM_REPAIRS.add(
+                    ("tile", "repaired" if repaired is not None
+                     else "rebuild")
+                )
+                if repaired is not None:
+                    IVM_REPAIR_EDGES.add(len(adds) + len(dels))
+            self._tiles = repaired
         self._device_stale = True
+
+    def _degrees_of_uids(self, uids: np.ndarray) -> np.ndarray:
+        """Out-degree per ROW-KEY uid (0 where the uid has no row) —
+        the histogram repair's before/after probe."""
+        if not len(uids):
+            return np.zeros(0, dtype=np.int64)
+        pos = np.searchsorted(self.h_src, uids)
+        pos = np.clip(pos, 0, max(0, self.n_rows - 1))
+        if self.n_rows == 0:
+            return np.zeros(len(uids), dtype=np.int64)
+        hit = self.h_src[pos] == uids
+        deg = self.h_offsets[pos + 1] - self.h_offsets[pos]
+        return np.where(hit, deg, 0).astype(np.int64)
+
+    def _hist_move(self, old_deg: int, new_deg: int) -> None:
+        """Shift one row between log2 degree buckets (bucket definition
+        mirrors degree_histogram: slot ⌈log2(deg)⌉, degree-1 rows in
+        slot 0; degree-0 rows are uncounted)."""
+        h = self._deg_hist
+        for deg, step in ((old_deg, -1), (new_deg, +1)):
+            if deg <= 0:
+                continue
+            b = (int(deg) - 1).bit_length()
+            if b >= len(h):
+                h = self._deg_hist = np.concatenate(
+                    [h, np.zeros(b + 1 - len(h), dtype=h.dtype)]
+                )
+            h[b] += step
 
     def ensure_device(self) -> None:
         """Re-upload device tensors from the host mirrors if a delta made
@@ -480,6 +549,23 @@ class CSRArena:
             self.offsets = fresh.offsets
             self.dst = fresh.dst
             self._device_stale = False
+
+
+def _ivm_repair_gate(n_delta: int, entry_edges: float) -> bool:
+    """The repair-vs-rebuild decision for one derived view (IVM): off
+    when the IVM gate is, else the planner's cost call
+    (query/planner.py::repair_route — recorded like every other route
+    decision, visible at /debug/planner)."""
+    from dgraph_tpu.ivm import ivm_enabled
+
+    if not ivm_enabled():
+        return False
+    from dgraph_tpu.query import planner
+
+    ok, dec = planner.repair_route(n_delta, entry_edges)
+    if dec is not None:
+        planner.record(None, dec)
+    return ok
 
 
 def _build_csr(rows_to_dsts: Dict[int, np.ndarray]) -> CSRArena:
@@ -844,9 +930,14 @@ class ArenaManager:
             # remaining per-predicate marks fall through to the loop:
             # their caches are already gone, so it just consumes deltas
         deltas = getattr(self.store, "delta", {})
+        bases = getattr(self.store, "delta_base", {})
         for p in list(dirty):
             delta = deltas.pop(p, None)
-            if delta is not None and self._try_apply_delta(p, delta):
+            # the journal window's repair base (models/store.py) is
+            # consumed WITH the journal — a stale base must never
+            # re-key a later window's entries
+            base = bases.pop(p, None)
+            if delta is not None and self._try_apply_delta(p, delta, base):
                 dirty.discard(p)
                 continue
             for key in [k for k in self._data if k == p or k.startswith(p + "\x00")]:
@@ -868,18 +959,32 @@ class ArenaManager:
                 self._lru_drop(self._index, key)
             dirty.discard(p)
 
-    def _try_apply_delta(self, pred: str, delta: list) -> bool:
+    def _try_apply_delta(self, pred: str, delta: list, base=None) -> bool:
         """Incrementally update the cached data (and reverse) arena for
         ``pred``.  Returns False when no cached arena exists (nothing to
         update — the next access builds fresh anyway) or a has-rows
-        variant is cached (its row universe can shift: full rebuild)."""
+        variant is cached (its row universe can shift: full rebuild).
+
+        IVM (dgraph_tpu/ivm/): after the arena mirrors absorb the
+        delta, the predicate's cached hop expansions absorb it too —
+        repaired IN PLACE and re-keyed from ``base`` (the pred version
+        every live entry carries, recorded when the journal window
+        opened) to the predicate's post-mutation version, behind the
+        planner's repair-vs-rebuild gate.  Entries a repair cannot fix
+        simply stay stale-keyed and die by sweep, exactly as before."""
         a = self._data.get(pred)
         if a is None or (pred + "\x00has") in self._data:
             return False
         if (pred, False) in self._sharded or (pred, True) in self._sharded:
             return False  # mesh-sharded copies rebuild wholesale
+        _E = np.zeros((0, 2), dtype=np.int64)
         if not delta:
-            return True  # facet-only touches: arenas unaffected
+            # facet-only touches: arenas unaffected, and the cached
+            # expansions are still EXACT — a zero-delta repair merely
+            # re-keys them to the new pred version (facet edits live in
+            # the host store, never in (out, seg_ptr))
+            self._repair_hop_entries(pred, a, _E, _E, base, gate=True)
+            return True
         # row-garbage bound: repeated delete churn leaves degree-0 rows
         # that only a full rebuild reclaims; rebuild once they dominate
         zero_rows = int(np.count_nonzero(np.diff(a.h_offsets) == 0))
@@ -898,7 +1003,68 @@ class ArenaManager:
         r = self._reverse.get(pred)
         if r is not None:
             r.apply_delta(adds[:, ::-1], dels[:, ::-1])
+        n_delta = len(adds) + len(dels)
+        self._repair_hop_entries(
+            pred, a, adds, dels, base,
+            # the cost prior prices a typical warm entry as a ~32-row
+            # frontier at this arena's mean fan-out (the tiers cap huge
+            # entries anyway, so the prior errs small → errs toward
+            # rebuild, the safe side)
+            gate=(n_delta > 0 and _ivm_repair_gate(
+                n_delta, max(1.0, a.avg_degree) * 32.0
+            )),
+        )
         return True
+
+    def _repair_hop_entries(
+        self, pred: str, a: CSRArena, adds, dels, base, gate: bool
+    ) -> None:
+        """Repair (or zero-delta re-key) the tier-1 entries for ``pred``
+        on both directions' arenas.  Skips entirely when: the gate said
+        rebuild, IVM is off (entries are keyed on the global version —
+        nothing here could re-key them safely), the journal window
+        carried no base, or a non-scopeable change (floor) landed
+        inside the window (a repaired entry must never claim freshness
+        across a schema epoch)."""
+        if self.hop_cache is None or not gate or base is None:
+            return
+        from dgraph_tpu import ivm
+        from dgraph_tpu.utils.metrics import IVM_REPAIR_EDGES, IVM_REPAIRS
+
+        if not ivm.ivm_enabled():
+            return
+        pv = getattr(self.store, "pred_versions", None)
+        if pv is None:
+            return
+        new_v = pv.get(pred, 0)
+        floor = getattr(self.store, "pred_floor", 0)
+        if new_v <= base or floor > base:
+            return
+        from dgraph_tpu import obs
+
+        repaired = dropped = 0
+        with obs.child("ivm.repair") as sp:
+            for arena, rev, ad, dl in (
+                (a, False, adds, dels),
+                (self._reverse.get(pred), True,
+                 adds[:, ::-1], dels[:, ::-1]),
+            ):
+                if arena is None:
+                    continue
+                rep, drop = self.hop_cache.repair_pred(
+                    id(arena), pred, rev, ad, dl, base, new_v
+                )
+                repaired += rep
+                dropped += drop
+            sp.set_attr("pred", pred)
+            sp.set_attr("delta", len(adds) + len(dels))
+            sp.set_attr("repaired", repaired)
+            sp.set_attr("dropped", dropped)
+        if repaired:
+            IVM_REPAIRS.add(("hop", "repaired"))
+            IVM_REPAIR_EDGES.add((len(adds) + len(dels)) * repaired)
+        if dropped:
+            IVM_REPAIRS.add(("hop", "rebuild"))
 
     # -- mesh sharding -------------------------------------------------------
 

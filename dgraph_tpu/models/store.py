@@ -175,6 +175,25 @@ class PostingStore:
         # journal here; value mutations always force a full refresh of
         # the value/index arenas (cheap: those arenas are value-sized).
         self.delta: Dict[str, Optional[List[Tuple[int, int, int]]]] = {}
+        # IVM (dgraph_tpu/ivm/): per-predicate freshness.  pred_versions
+        # maps each predicate to the version of the LAST mutation that
+        # touched it; pred_floor is the version of the last change that
+        # cannot be scoped to predicates (schema mutation, full-store
+        # replacement).  Cache tiers key entries on
+        # max(floor, max(pred_versions[footprint])) via ivm/versions.py
+        # instead of the global version above, so a mutation only
+        # invalidates entries that reference its predicates.  delta_base
+        # records, per journaled predicate, the pred version BEFORE the
+        # journal's first delta — the version every live cache entry for
+        # that predicate carries, which the delta-repair path
+        # (models/arena.py) needs to re-key repaired entries safely.
+        self.pred_versions: Dict[str, int] = {}
+        self.pred_floor = 0
+        self.delta_base: Dict[str, int] = {}
+        # mutation delta stream (ivm/deltas.py), attached by the serving
+        # layer for live-query subscriptions; None costs one attribute
+        # read per mutation
+        self.delta_stream = None
         # runtime cluster membership (MEMBER records) — only meaningful
         # on the metadata group's replica store; member_hook fires on
         # apply so the cluster service can rewire transports live
@@ -232,14 +251,42 @@ class PostingStore:
         d = self.delta.get(pred, [])
         if d is None:
             return  # already overflowed
+        if pred not in self.delta:
+            # fresh journal window: remember the pred version its views
+            # were built at (repair re-keys entries FROM this version)
+            self.delta_base[pred] = self.pred_versions.get(pred, 0)
         if len(d) >= self.DELTA_MAX:
             self.delta[pred] = None
             return
         d.append((src, dst, sign))
         self.delta[pred] = d
 
+    def _journal_touch(self, pred: str) -> None:
+        """Journal a no-op/facet-only touch: arenas are unaffected, so
+        an EMPTY entry lets refresh skip the rebuild (setdefault
+        preserves an overflow None) — but the window still needs its
+        repair base recorded (see _journal_delta)."""
+        if pred not in self.delta:
+            self.delta_base[pred] = self.pred_versions.get(pred, 0)
+            self.delta[pred] = []
+
     def _delta_overflow(self, pred: str) -> None:
         self.delta[pred] = None
+
+    def _note_pred_mutation(self, pred: str, stream_kind: str = "",
+                            src: int = 0, dst: int = 0, sign: int = 0) -> None:
+        """Per-predicate freshness + delta-stream publication for ONE
+        mutation (the version was already bumped).  ``stream_kind``:
+        "edge" publishes the exact edge delta, "pred" a whole-predicate
+        change, "" nothing (callers that publish separately)."""
+        self.pred_versions[pred] = self.version
+        ds = self.delta_stream
+        if ds is None or not stream_kind:
+            return
+        if stream_kind == "edge":
+            ds.publish_edge(pred, src, dst, sign, self.version)
+        else:
+            ds.publish_pred(pred, self.version)
 
     def apply(self, e: Edge) -> None:
         """Apply one edge mutation (AddMutationWithIndex analog,
@@ -248,6 +295,10 @@ class PostingStore:
         self.dirty.add(e.pred)
         self.version += 1
         p._wdmirror = None  # any mutation can change uids-with-data
+        # IVM stream shape of this mutation: an exact edge delta when
+        # one exists, else a whole-predicate change (value/facet edits
+        # have no per-edge form the repair path could apply)
+        kind, sign = "pred", 0
         if e.op == "set":
             if e.value is not None:
                 p.values[(e.src, e.lang)] = e.value
@@ -268,11 +319,12 @@ class PostingStore:
                 if e.dst not in tgt:
                     tgt.add(e.dst)
                     self._journal_delta(e.pred, e.src, e.dst, +1)
+                    kind, sign = "edge", +1
                 else:
                     # facet-only / no-op touch: arenas unaffected — keep
                     # an (empty) journal entry so refresh skips the
-                    # rebuild (setdefault preserves an overflow None)
-                    self.delta.setdefault(e.pred, [])
+                    # rebuild (an overflow None is preserved)
+                    self._journal_touch(e.pred)
                 if e.facets:
                     p.edge_facets[(e.src, e.dst)] = dict(e.facets)
                     p._efmirror = None
@@ -295,12 +347,14 @@ class PostingStore:
                     if not s:
                         del p.edges[e.src]
                     self._journal_delta(e.pred, e.src, e.dst, -1)
+                    kind, sign = "edge", -1
                 else:
-                    self.delta.setdefault(e.pred, [])  # no-op delete
+                    self._journal_touch(e.pred)  # no-op delete
                 if p.edge_facets.pop((e.src, e.dst), None) is not None:
                     p._efmirror = None
         else:
             raise ValueError(f"unknown mutation op {e.op!r}")
+        self._note_pred_mutation(e.pred, kind, e.src, e.dst, sign)
 
     def apply_many(self, edges: Iterable[Edge]) -> int:
         n = 0
@@ -308,6 +362,16 @@ class PostingStore:
             self.apply(e)
             n += 1
         return n
+
+    # bulk_set_uid_edges batches at or under this size journal per-edge
+    # deltas like apply() instead of overflowing: the serving path's
+    # fast mutation scanner (serve/bulk.py) routes EVERY set mutation
+    # here — including the single-edge point writes whose cached views
+    # the IVM layer repairs in place — and an unconditional overflow
+    # forced a full arena rebuild (and killed every repairable entry)
+    # per point write.  Genuine bulk loads sail past it into the
+    # rebuild-is-cheaper path unchanged.
+    BULK_JOURNAL_MAX = 256
 
     def bulk_set_uid_edges(self, pred: str, src, dst) -> None:
         """Vectorized ingest of plain uid edges (no facets): group-by-src
@@ -324,6 +388,20 @@ class PostingStore:
         self.dirty.add(pred)
         self.version += 1
         p._wdmirror = None  # uids-with-data changes under bulk adds too
+        if len(src) <= self.BULK_JOURNAL_MAX:
+            # point-write shape: per-edge journal entries (new edges
+            # +1, duplicates an empty touch) so arena delta refresh and
+            # IVM view repair keep working through the serving path
+            edges = p.edges
+            for s, d in zip(src.tolist(), dst.tolist()):
+                tgt = edges.setdefault(s, set())
+                if d not in tgt:
+                    tgt.add(d)
+                    self._journal_delta(pred, s, d, +1)
+                else:
+                    self._journal_touch(pred)
+            self._note_pred_mutation(pred, "pred")
+            return
         self._delta_overflow(pred)  # bulk volume: full rebuild is cheaper
         order = np.argsort(src, kind="stable")
         s = src[order]
@@ -338,6 +416,7 @@ class PostingStore:
                 edges[u] = set(d[b0:b1].tolist())
             else:
                 tgt.update(d[b0:b1].tolist())
+        self._note_pred_mutation(pred, "pred")  # bulk: no per-edge stream
 
     def bulk_set_values(self, pred: str, items) -> None:
         """Vectorized ingest of plain (facet-less) value edges: ONE dict
@@ -367,6 +446,7 @@ class PostingStore:
                 del p._has_langs
             except AttributeError:
                 pass
+        self._note_pred_mutation(pred, "pred")
 
     def apply_schema(self, text: str) -> None:
         """Parse schema text into this store's schema state; journaled
@@ -375,6 +455,11 @@ class PostingStore:
 
         parse_schema(text, into=self.schema)
         self.version += 1
+        # schema changes (type/index/reverse semantics) are not scoped
+        # to a predicate's POSTINGS: bump the IVM floor so every
+        # footprint-keyed cache entry goes stale, exactly like the
+        # global version did
+        self.note_global_change()
 
     def delete_predicate(self, pred: str) -> None:
         """posting.DeletePredicate analog (posting/index.go:666)."""
@@ -382,6 +467,18 @@ class PostingStore:
         self.dirty.add(pred)
         self.version += 1
         self._delta_overflow(pred)
+        self._note_pred_mutation(pred, "pred")
+
+    def note_global_change(self) -> None:
+        """Record a change that cannot be scoped to predicates (schema
+        mutation, full-store replacement): the IVM floor advances to the
+        current version, so EVERY footprint-keyed cache entry goes
+        stale — predicate scoping degrades to the global behavior for
+        exactly these events."""
+        self.pred_floor = self.version
+        ds = self.delta_stream
+        if ds is not None:
+            ds.publish_epoch(self.version)
 
     def set_edge(self, pred: str, src: int, dst: int, facets=None):
         self.apply(Edge(pred=pred, src=src, dst=dst, facets=facets))

@@ -182,6 +182,60 @@ def build_tiles(
     )
 
 
+def apply_tile_delta(
+    pt: PredTiles, adds: np.ndarray, dels: np.ndarray
+) -> Optional[PredTiles]:
+    """IVM delta repair (dgraph_tpu/ivm/): apply (src, dst) uid-edge
+    deltas to the stored blocks in place — a tile delta is ONE batched
+    scatter on the [K, T, T] stack (set 1.0 for adds, 0.0 for dels)
+    plus a degree-vector adjustment, instead of dropping the whole
+    densified layout and paying a full rebuild on the next join.
+
+    Returns the repaired PredTiles (same object, tensors replaced), or
+    None when repair is structurally impossible and the caller must
+    fall back to a rebuild: an edge lands outside the block grid (the
+    universe grew) or an ADD lands in a block that was never
+    materialized (densifying new blocks IS the rebuild).  A delete that
+    empties a block keeps the zero block resident — it contributes
+    nothing to any product, and the next full rebuild reclaims it.
+
+    Semantic parity with a fresh build (pinned by tests/test_ivm.py):
+    the densified adjacency matrix and the degree vector match
+    ``build_tiles`` over the post-delta CSR exactly; only the block
+    LIST may differ by such empty blocks."""
+    t, nb = pt.t, pt.nb
+    parts = []
+    for arr, val in ((adds, 1.0), (dels, 0.0)):
+        if len(arr):
+            a = np.asarray(arr, dtype=np.int64).reshape(-1, 2)
+            parts.append((a[:, 0], a[:, 1], np.full(len(a), val, np.float32)))
+    if not parts:
+        return pt
+    u = np.concatenate([p[0] for p in parts])
+    v = np.concatenate([p[1] for p in parts])
+    vals = np.concatenate([p[2] for p in parts])
+    if int(u.max()) >= nb * t or int(v.max()) >= nb * t or u.min() < 0 or v.min() < 0:
+        return None  # universe grew past the block grid
+    keys = (u // t) * nb + (v // t)
+    hbi = np.asarray(pt.bi)[: pt.n_tiles].astype(np.int64)
+    hbj = np.asarray(pt.bj)[: pt.n_tiles].astype(np.int64)
+    skeys = hbi * nb + hbj  # np.unique build order: ascending
+    pos = np.searchsorted(skeys, keys)
+    pos = np.clip(pos, 0, max(0, len(skeys) - 1))
+    if len(skeys) == 0 or not bool(np.all(skeys[pos] == keys)):
+        return None  # some edge's block was never materialized
+    pt.tiles = pt.tiles.at[pos, u % t, v % t].set(jnp.asarray(vals))
+    n_degs = pt.degs.shape[0]
+    deg_delta = np.zeros(n_degs, dtype=np.int32)
+    for arr, sign in ((adds, 1), (dels, -1)):
+        if len(arr):
+            a = np.asarray(arr, dtype=np.int64).reshape(-1, 2)
+            np.add.at(deg_delta, a[:, 0], sign)
+    pt.degs = pt.degs + jnp.asarray(deg_delta)
+    pt.universe = max(pt.universe, int(u.max()) + 1, int(v.max()) + 1)
+    return pt
+
+
 # -- mask algebra -------------------------------------------------------------
 
 

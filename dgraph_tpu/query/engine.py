@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from dgraph_tpu import gql, obs, ops
+from dgraph_tpu import gql, ivm, obs, ops
 from dgraph_tpu.gql.ast import (
     FilterTree,
     Function,
@@ -232,7 +232,12 @@ class DeviceExpander:
             # head) should not even pay for the digest
             est = (len(src) + len(src) * arena.avg_degree) * 8
             if est <= hc.max_entry_bytes:
-                ver = getattr(self.engine.store, "version", None)
+                # predicate-scoped freshness (ivm/versions.py): the
+                # entry keys on THIS predicate's last-mutation version,
+                # so writes to other predicates leave it a hit — and
+                # small deltas to this one REPAIR it in place
+                # (ArenaManager._try_apply_delta) instead of killing it
+                ver = ivm.hop_version(self.engine.store, attr)
         if ver is not None:
             # one digest per call: the miss path re-uses it for the fill
             hkey = hc.key_for(arena, attr, reverse, src)

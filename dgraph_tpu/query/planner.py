@@ -360,6 +360,63 @@ def kway_route(total: int, k: int) -> Tuple[Optional[bool], Optional[dict]]:
     return use_device, dec
 
 
+def repair_route(
+    n_delta: int, avg_entry_edges: float
+) -> Tuple[bool, Optional[dict]]:
+    """IVM delta repair (dgraph_tpu/ivm/): apply a mutation's edge
+    deltas to a cached derived view IN PLACE, or drop it and let the
+    next read rebuild?  Returns (repair, decision).
+
+    Mode discipline (planconfig DGRAPH_TPU_IVM_REPAIR): '0' never,
+    'force' always (the delta cap still bounds the work), '1' the cost
+    compare below.  Static path (planner off / cap pinned): repair iff
+    the delta fits the cap.
+
+    Cost framing: repair is paid ONCE, now, on the refresh path — one
+    memcpy-shaped pass over the entry plus the delta
+    (``(E + D) × host_edge``).  Dropping defers to a refill the next
+    hit-turned-miss pays in full — and an entry worth caching is read
+    more than once (the zipf head is why the tiers exist), so the
+    refill side is priced at TWO expected re-expansions of the entry,
+    each at the cheaper of the host and device routes.  Small deltas
+    against warm entries therefore repair; a delta rivaling the entry
+    itself rebuilds."""
+    mode = planconfig.ivm_repair_mode()
+    if mode == "0":
+        return False, None
+    cap = planconfig.ivm_repair_max_delta()
+    if mode == "force":
+        return n_delta <= cap, None
+    if n_delta > cap:
+        return False, None
+    if not enabled() or planconfig.overridden(
+        "DGRAPH_TPU_IVM_REPAIR_MAX_DELTA"
+    ):
+        return True, None  # static gate: the cap IS the decision
+    r = rates()
+    e = max(float(avg_entry_edges), 1.0)
+    repair_us = r["host_setup_us"] + (e + n_delta) * r["host_edge_us"]
+    refill_us = 2.0 * min(
+        r["host_setup_us"] + e * r["host_edge_us"],
+        r["dispatch_us"] + e * r["device_edge_us"],
+    )
+    repair = repair_us < refill_us
+    dec = {
+        "kind": "repair",
+        "route": "repair" if repair else "rebuild",
+        "units": int(n_delta),
+        "entry_edges": int(e),
+        "est_chosen_us": round(repair_us if repair else refill_us, 1),
+        "est_other_us": round(refill_us if repair else repair_us, 1),
+        "reason": (
+            "delta repair cheaper than the expected refills"
+            if repair
+            else "delta rivals the entry: drop and rebuild on demand"
+        ),
+    }
+    return repair, dec
+
+
 def mxu_fanout_ok(engine, est_total: int, n_levels: int) -> bool:
     """The MXU tier's fan-out admission: is this chain big enough to
     leave the host at all?  Shares chain_route's model (and its override

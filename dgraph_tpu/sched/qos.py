@@ -17,8 +17,12 @@ serving surfaces wire them in:
   weight (fair-share of cohort flush slots), ``max_queued`` (admission
   quota: over it sheds 429 with a tenant-scoped ``Retry-After`` BEFORE
   the global cap), ``max_inflight`` (concurrent execution cap; a tenant
-  at its cap keeps queueing, its cohorts just wait), and a free-form
-  ``priority`` class label for dashboards.  Configured via the
+  at its cap keeps queueing, its cohorts just wait), a ``priority``
+  class (low/standard/high/critical) folded into the DRR weight as a
+  multiplier (``PRIORITY_FACTORS`` — a high-priority tenant's cohorts
+  win the fair-share race proportionally more often), and ``max_subs``
+  (live-query subscription quota, dgraph_tpu/ivm/subs.py).  Configured
+  via the
   ``DGRAPH_TPU_QOS_TENANTS`` JSON knob (docs/deploy.md "Multi-tenant
   QoS"); unconfigured tenants inherit the ``DGRAPH_TPU_QOS_DEFAULT_*``
   defaults (weight 1, no quota), so absent configuration changes
@@ -308,10 +312,27 @@ def grpc_timeout(context) -> Optional[float]:
 # ---------------------------------------------------------- tenant config
 
 
+# priority-class multipliers folded into the DRR weight (satellite:
+# ``priority`` used to be a dead dashboard label with no scheduling
+# semantics).  The classes are coarse on purpose — priority expresses
+# "this tenant's cohorts win the fair-share race K× more often", not a
+# preemption lattice; an unknown class reads as standard (×1) so a
+# config typo degrades to today's behavior instead of starving anyone.
+PRIORITY_FACTORS = {
+    "low": 0.5,
+    "standard": 1.0,
+    "high": 2.0,
+    "critical": 4.0,
+}
+
+
 class TenantConfig:
     """One tenant's QoS envelope (see module docstring for semantics)."""
 
-    __slots__ = ("name", "weight", "max_queued", "max_inflight", "priority")
+    __slots__ = (
+        "name", "weight", "max_queued", "max_inflight", "priority",
+        "max_subs",
+    )
 
     def __init__(
         self,
@@ -320,19 +341,31 @@ class TenantConfig:
         max_queued: int = 0,
         max_inflight: int = 0,
         priority: str = "standard",
+        max_subs: int = 0,
     ):
         self.name = name
         self.weight = max(float(weight), 1e-3)
         self.max_queued = max(int(max_queued), 0)      # 0 = global cap only
         self.max_inflight = max(int(max_inflight), 0)  # 0 = unbounded
         self.priority = str(priority)
+        # live-query subscription quota (dgraph_tpu/ivm/subs.py);
+        # 0 = the registry's DGRAPH_TPU_SUBS_PER_TENANT default
+        self.max_subs = max(int(max_subs), 0)
+
+    @property
+    def effective_weight(self) -> float:
+        """DRR weight with the priority class folded in — the value the
+        scheduler's weighted-fair pick actually races."""
+        return self.weight * PRIORITY_FACTORS.get(self.priority, 1.0)
 
     def to_dict(self) -> dict:
         return {
             "weight": self.weight,
+            "effective_weight": self.effective_weight,
             "max_queued": self.max_queued,
             "max_inflight": self.max_inflight,
             "priority": self.priority,
+            "max_subs": self.max_subs,
         }
 
 
@@ -383,6 +416,7 @@ class QosConfig:
                         max_queued=spec.get("max_queued", dq),
                         max_inflight=spec.get("max_inflight", di),
                         priority=spec.get("priority", "standard"),
+                        max_subs=spec.get("max_subs", 0),
                     )
             except (ValueError, TypeError, OverflowError) as e:
                 note_swallowed("qos.tenant_config", e)

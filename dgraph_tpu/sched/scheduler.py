@@ -82,6 +82,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from dgraph_tpu import ivm as _ivm
 from dgraph_tpu import obs
 from dgraph_tpu.sched import qos as _qos
 from dgraph_tpu.sched.cohort import (
@@ -240,17 +241,25 @@ class CohortScheduler:
             cancel.check()
         # duck-typed stores (ClusterStore) may predate .version; 0 keeps
         # them schedulable, merely coalescing across mutation boundaries
-        # their own read path already treats as eventually consistent
+        # their own read path already treats as eventually consistent.
+        # This read feeds the ADMISSION signature (snapshot bucketing for
+        # cohorts + singleflight), never a cache key — the tier-2 key
+        # below is predicate-scoped through ivm/versions.py.
+        # graftlint: ignore[naked-version-key]
         store_ver = getattr(self._server.store, "version", None)
         sig = hop_signature(parsed, store_ver or 0)
         # tier-2 probe BEFORE admission: the version in the key is
-        # captured pre-execution (sig[0]), so a racing mutation can only
-        # strand an entry under an old version — never serve stale.  A
-        # store with NO version has no mutation epoch to key under, and
-        # a store whose version is not STRICT (ClusterStore: remote-TTL
-        # reads refresh without a bump, and only during execution) must
-        # never cache — a warm hit would starve its freshness probes.
-        rc_key = None
+        # captured pre-execution, so a racing mutation can only strand
+        # an entry under an old version — never serve stale.  The key
+        # version is SCOPED to the request's referenced-predicate
+        # footprint (ivm/versions.py): a mutation to a predicate this
+        # request never reads leaves its entry a hit (DGRAPH_TPU_IVM=0
+        # restores the bare global version).  A store with NO version
+        # has no mutation epoch to key under, and a store whose version
+        # is not STRICT (ClusterStore: remote-TTL reads refresh without
+        # a bump, and only during execution) must never cache — a warm
+        # hit would starve its freshness probes.
+        rc_key = rc_ver = None
         rc = self.result_cache
         if (
             rc is not None
@@ -262,7 +271,8 @@ class CohortScheduler:
 
             if cacheable(parsed):
                 rc_key = key
-                hit = rc.get(rc_key, sig[0])
+                rc_ver = _ivm.result_version(self._server.store, parsed)
+                hit = rc.get(rc_key, rc_ver)
                 if hit is not None:
                     return hit
         # timeout_s None = no budget; <= 0 = budget ALREADY spent (a
@@ -296,7 +306,7 @@ class CohortScheduler:
         if rc_key is not None:
             # sharing the response dict is safe by the singleflight
             # argument: handlers only encode results, never mutate them
-            rc.put(rc_key, sig[0], result, stats)
+            rc.put(rc_key, rc_ver, result, stats)
         return result, stats
 
     def _admit(self, req: SchedRequest, sig: tuple, key) -> None:
@@ -491,8 +501,11 @@ class CohortScheduler:
         if len(by_tenant) == 1:
             t = next(iter(by_tenant))
         else:
+            # priority class folds into the raced weight (a "high"
+            # tenant at weight 1 competes like weight 2 — qos.py
+            # PRIORITY_FACTORS); proportions stay deterministic
             t = self._drr.pick(
-                {t: self.qos.tenant(t).weight for t in by_tenant}
+                {t: self.qos.tenant(t).effective_weight for t in by_tenant}
             )
         return min(by_tenant[t], key=lambda k: self._queues[k].born)
 

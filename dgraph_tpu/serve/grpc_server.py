@@ -397,6 +397,13 @@ class GrpcServer:
                     return grpc.unary_unary_rpc_method_handler(svc._check)
                 if m == "/protos.Dgraph/AssignUids":
                     return grpc.unary_unary_rpc_method_handler(svc._assign)
+                if m == "/protos.Dgraph/Subscribe":
+                    # live-query subscription (dgraph_tpu/ivm/subs.py):
+                    # one Request in, a server-stream of Responses out —
+                    # the gRPC twin of POST /subscribe's SSE
+                    return grpc.unary_stream_rpc_method_handler(
+                        svc._subscribe
+                    )
                 # Worker plane (payload.proto:28): the intra-cluster RPCs
                 if m == "/protos.Worker/Echo":
                     return grpc.unary_unary_rpc_method_handler(svc._echo)
@@ -545,6 +552,74 @@ class GrpcServer:
                 (("dgraph-degraded", _json.dumps(deg)),)
             )
         return _p.encode_response(out)
+
+    def _subscribe(self, req: bytes, context):
+        """Server-stream of re-evaluated results for one registered
+        live query.  Each message is a normal Response whose extra
+        ``_subscription_`` block carries the push metadata (sub id,
+        seq, trigger preds, trace id); the stream ends when the
+        subscription is cancelled (unsubscribe/shutdown) and the
+        registry drops the subscription when the CLIENT goes away
+        (``context.is_active()`` — a live query with no listener is
+        pure waste)."""
+        import grpc
+
+        srv = self._server
+        if srv.subs is None:
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "subscriptions disabled (DGRAPH_TPU_IVM/DGRAPH_TPU_SUBS)",
+            )
+        try:
+            text, vars_ = decode_request(req)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"bad Request message: {e}")
+        try:
+            md = dict(context.invocation_metadata())
+        except Exception:  # noqa: BLE001 — metadata is optional
+            md = {}
+        from dgraph_tpu.ivm.subs import SubQuotaError
+
+        try:
+            sub = srv.subs.register(
+                text, vars_ or None, tenant=md.get("x-dgraph-tenant", "")
+            )
+        except SubQuotaError as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except Exception as e:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT
+                if isinstance(e, ValueError)
+                else grpc.StatusCode.INTERNAL,
+                str(e),
+            )
+        try:
+            while True:
+                try:
+                    active = context.is_active()
+                except Exception:  # noqa: BLE001 — transport quirk
+                    active = True
+                if not active:
+                    return
+                ev = sub.next_event(timeout=1.0)
+                if ev is None:
+                    continue
+                if ev.get("kind") == "cancelled":
+                    return
+                out = dict(ev.get("data") or {})
+                out["_subscription_"] = [{
+                    "sub_id": ev.get("sub_id", ""),
+                    "seq": int(ev.get("seq", 0)),
+                    "kind": ev.get("kind", "update"),
+                    "version": int(ev.get("version", 0)),
+                    "preds": ",".join(ev.get("preds") or []),
+                    "trace_id": ev.get("trace_id") or "",
+                }]
+                yield _p.encode_response(out)
+        finally:
+            if not sub.token.cancelled:
+                srv.subs.cancel(sub.id, reason="disconnect")
 
     def _check(self, req: bytes, context):
         return encode_version()

@@ -600,6 +600,35 @@ JOIN_TILE_BUILDS = metrics.counter("dgraph_join_tile_builds_total")
 JOIN_TILE_BYTES = metrics.counter("dgraph_join_tile_built_bytes_total")
 
 
+# incremental view maintenance (dgraph_tpu/ivm/): the delta stream's
+# publication rate by event kind (edge/pred/epoch) and its overflow
+# losses; every repair-vs-rebuild outcome per derived-view kind
+# (hop-cache entries, tile blocks) with the edge volume the repair path
+# absorbed.  A rising hop:rebuild share means writes are outpacing the
+# repair gate — check /debug/planner's "repair" decisions.
+IVM_DELTAS = metrics.labeled("dgraph_ivm_deltas_total", label="kind")
+IVM_STREAM_DROPPED = metrics.counter("dgraph_ivm_stream_dropped_total")
+IVM_REPAIRS = metrics.multilabeled(
+    "dgraph_ivm_repairs_total", ("kind", "outcome")
+)
+IVM_REPAIR_EDGES = metrics.counter("dgraph_ivm_repair_edges_total")
+
+
+# live-query subscriptions (dgraph_tpu/ivm/subs.py): active
+# registrations, re-evaluations run, events by disposition (push =
+# changed result delivered / skip = re-evaluated but unchanged /
+# lagged = a slow consumer's queue overflowed and dropped its oldest),
+# and registration sheds by reason (quota/cap/parse).
+SUBS_ACTIVE = metrics.gauge("dgraph_subscription_active")
+SUBS_EVALS = metrics.counter("dgraph_subscription_evals_total")
+SUBS_EVENTS = metrics.labeled(
+    "dgraph_subscription_events_total", label="kind"
+)
+SUBS_SHED = metrics.labeled(
+    "dgraph_subscription_shed_total", label="reason"
+)
+
+
 def note_swallowed(site: str, exc: BaseException) -> None:
     """Count an intentionally-dropped exception at ``site`` (a short
     dotted location like ``transport.grpc_send``).  The exception TYPE

@@ -58,6 +58,15 @@ DGRAPH_TPU_CALIBRATION_FILE  scratch/planner_calib.json
 DGRAPH_TPU_CALIBRATE          "0"    "1" re-measures at server boot and
                                      re-persists (stale-calibration
                                      remedy); default boots load the file
+DGRAPH_TPU_IVM_REPAIR         "1"    IVM delta repair of cached hop
+                                     entries / tile blocks: 0 drop-only /
+                                     1 cost-gated / force (skip the
+                                     cost compare, cap still applies)
+DGRAPH_TPU_IVM_REPAIR_MAX_DELTA 512  hard cap on the edge-delta size the
+                                     repair path will apply in place;
+                                     larger mutation batches drop the
+                                     affected views (static fallback
+                                     gate when the planner is off)
 ========================== ========= =====================================
 
 Reads happen per call (not at import) so tests can flip knobs with
@@ -87,6 +96,7 @@ TILE_DEFAULT = 128
 TILE_BUDGET_DEFAULT = 1 << 28
 CLASS_W_MAX_DEFAULT = 10
 CALIBRATION_FILE_DEFAULT = "scratch/planner_calib.json"
+IVM_REPAIR_MAX_DELTA_DEFAULT = 512
 
 
 def overridden(name: str) -> bool:
@@ -188,6 +198,22 @@ def calibration_file() -> str:
     persistence entirely)."""
     return os.environ.get(
         "DGRAPH_TPU_CALIBRATION_FILE", CALIBRATION_FILE_DEFAULT
+    )
+
+
+def ivm_repair_mode() -> str:
+    """DGRAPH_TPU_IVM_REPAIR: '0' never repair (drop-only, the pre-IVM
+    behavior for affected views), '1' cost-gated (default; the planner
+    prices repair-now against refill-later), 'force' always repair when
+    structurally possible (the cap below still bounds the work)."""
+    return os.environ.get("DGRAPH_TPU_IVM_REPAIR", "1")
+
+
+def ivm_repair_max_delta() -> int:
+    """Hard edge-delta cap for in-place view repair — the static gate
+    when the planner is off, and the work bound in every mode."""
+    return _int(
+        "DGRAPH_TPU_IVM_REPAIR_MAX_DELTA", IVM_REPAIR_MAX_DELTA_DEFAULT
     )
 
 
