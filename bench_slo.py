@@ -1,0 +1,642 @@
+#!/usr/bin/env python
+"""Open-loop SLO harness: latency-vs-offered-load until saturation.
+
+Every serving number before this harness was CLOSED-loop: N client
+threads firing as fast as responses return, which self-throttles
+exactly when the server slows down — the measured "QPS" is then a
+property of the feedback loop, not of the service, and the tail
+latency hides coordinated omission.  This harness is OPEN-loop
+(Banyan's serving-quality argument, PAPERS.md; the wrk2 discipline):
+
+- arrivals are a **Poisson process at a swept offered rate** — the
+  whole schedule is drawn up front from a seeded RNG, so a run is
+  reproducible and the server's slowness cannot postpone the next
+  arrival;
+- every request's latency is measured **from its scheduled arrival
+  time**, so a sender that fell behind charges the wait to the server
+  (no coordinated omission);
+- each offered-rate step reports **per-class p50/p99/p999 and the shed
+  rate** (HTTP 429/504 are outcomes, not errors), and the sweep stops
+  once the server is saturated;
+- the output is one SLO-curve JSON **keyed by backend**, with a
+  detected **saturation knee** — the number every future perf PR (mesh
+  serving, Pallas tier) is judged against.
+
+Workload: a mixed production shape — point reads, 2-hop traversals and
+a mutation interleave — not a single query family.  Two ROADMAP
+follow-ups fold in as arms of the same harness:
+
+- **qos**: the PR-11 antagonist/victim A/B re-measured open-loop —
+  victim p999 vs the antagonist's offered load, QoS on vs off —
+  replacing the closed-loop ratio;
+- **ivm**: the PR-12 write-rate sweep re-measured open-loop — achieved
+  QPS and p99 at a FIXED offered read load while the write rate sweeps.
+
+Knobs (env, all sized for the 2-core CI host by default):
+  SLO_RATES          offered-load sweep, qps CSV (default "25,50,100,200,400")
+  SLO_STEP_SECONDS   seconds per step (4)
+  SLO_NODES/SLO_DEG  store size (20000 / 16)
+  SLO_WORKERS        sender threads = max in-flight (32)
+  SLO_MIX            class weights "point=0.45,khop=0.45,mutation=0.1"
+  SLO_CACHE          result/hop cache during the main sweep (1)
+  SLO_SAT_STOP       stop the sweep past this shed rate (0.5)
+  SLO_QOS / SLO_IVM  run the arms (1 / 1)
+  SLO_QOS_RATES      antagonist offered-load sweep ("50,200")
+  SLO_VICTIM_RATE    victim offered load, qps (10)
+  SLO_IVM_RATE       fixed read load for the ivm arm (50)
+  SLO_IVM_WRITE_RATES  write-rate sweep, writes/s CSV ("0,10,25")
+  SLO_SEED           RNG seed (7)
+  SLO_OUT            also write the JSON to this path
+  SLO_SMOKE          arm the CI smoke assertions (monotone shed rate,
+                     well-formed JSON) — see .github/workflows/ci.yml
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+from bench import _serving_store, ensure_backend
+
+
+# ---------------------------------------------------------------- helpers
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_rates(name: str, default: str):
+    return [
+        float(x) for x in os.environ.get(name, default).split(",")
+        if x.strip()
+    ]
+
+
+def pctile(lats, q: float) -> float:
+    """Latency percentile in ms over a list of seconds (empty → 0)."""
+    if not lats:
+        return 0.0
+    a = np.sort(np.asarray(lats))
+    return float(a[min(len(a) - 1, int(q * (len(a) - 1) + 0.5))]) * 1e3
+
+
+def latency_summary(lats) -> dict:
+    return {
+        "n": len(lats),
+        "p50_ms": round(pctile(lats, 0.50), 2),
+        "p99_ms": round(pctile(lats, 0.99), 2),
+        "p999_ms": round(pctile(lats, 0.999), 2),
+    }
+
+
+def poisson_schedule(rate_qps: float, secs: float, rng) -> np.ndarray:
+    """Arrival offsets (seconds from step start) of a Poisson process at
+    ``rate_qps``, truncated to the step window.  Drawn UP FRONT: the
+    server can be arbitrarily slow and the offered load does not move."""
+    n = int(rate_qps * secs * 2) + 16
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_qps, size=n))
+    return arrivals[arrivals < secs]
+
+
+# -------------------------------------------------------------- workload
+
+def build_mix(n_nodes: int, rng) -> list:
+    """The mixed workload: each class is (name, weight, query pool,
+    tenant).  Pools are pre-drawn so a step's body generation is a list
+    index, never RNG work on the send path."""
+    weights = {}
+    for part in os.environ.get(
+        "SLO_MIX", "point=0.45,khop=0.45,mutation=0.1"
+    ).split(","):
+        k, _, v = part.partition("=")
+        weights[k.strip()] = float(v)
+    point = [
+        "{ q(func: uid(0x%x)) { c: count(e) } }" % u
+        for u in np.unique(rng.integers(1, n_nodes + 1, size=64))
+    ]
+    khop = []
+    for _ in range(64):
+        seeds = np.unique(rng.integers(1, n_nodes + 1, size=8))
+        ul = ", ".join("0x%x" % u for u in seeds)
+        khop.append("{ q(func: uid(%s)) { e { c: count(e) } } }" % ul)
+    # mutation interleave: edge toggles on a scratch uid range far above
+    # the graph (adds followed by deletes on later draws keep the store
+    # from growing without bound across a long sweep)
+    mutation = []
+    for i in range(64):
+        u = 0x500000 + (i % 97)
+        verb = "set" if i % 2 == 0 else "delete"
+        mutation.append(
+            "mutation { %s { <0x%x> <e> <0x%x> . } }" % (verb, u, u + 1)
+        )
+    pools = {"point": point, "khop": khop, "mutation": mutation}
+    return [
+        {"name": name, "weight": w, "pool": pools[name], "tenant": ""}
+        for name, w in weights.items()
+        if w > 0 and name in pools
+    ]
+
+
+# -------------------------------------------------------- open-loop step
+
+def open_loop_step(
+    port: int, classes: list, secs: float, seed: int,
+    workers: int,
+) -> dict:
+    """Run one offered-load step against a live server.
+
+    ``classes`` carry their OWN rates: [{name, rate, pool, tenant}] —
+    the mixed-workload sweep gives each class a share of one swept
+    rate, the qos arm pins the victim's rate while the antagonist's
+    sweeps.  Senders are a bounded worker pool pulling a pre-drawn
+    merged schedule; when all workers are busy a request starts late
+    and the delay is charged to its latency (measured from scheduled
+    arrival — the whole point of open loop)."""
+    rng = np.random.default_rng(seed)
+    events = []  # (offset_s, class index, body, tenant)
+    for ci, c in enumerate(classes):
+        if c["rate"] <= 0:
+            continue
+        offs = poisson_schedule(c["rate"], secs, rng)
+        pool = c["pool"]
+        picks = rng.integers(0, len(pool), size=len(offs))
+        for off, pi in zip(offs, picks):
+            events.append((float(off), ci, pool[int(pi)], c["tenant"]))
+    events.sort(key=lambda e: e[0])
+    offered = len(events) / secs if secs else 0.0
+
+    lock = threading.Lock()
+    pos = [0]
+    per_class = [
+        {"lats": [], "ok": 0, "shed": 0, "errors": 0} for _ in classes
+    ]
+    max_lag = [0.0]
+    anchor = time.monotonic() + 0.05
+
+    def sender():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            while True:
+                with lock:
+                    i = pos[0]
+                    pos[0] += 1
+                if i >= len(events):
+                    return
+                off, ci, body, tenant = events[i]
+                due = anchor + off
+                delay = due - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                else:
+                    with lock:
+                        max_lag[0] = max(max_lag[0], -delay)
+                headers = {"X-Dgraph-Tenant": tenant} if tenant else {}
+                status = -1
+                for attempt in (0, 1):
+                    try:
+                        conn.request(
+                            "POST", "/query", body=body.encode(),
+                            headers=headers,
+                        )
+                        r = conn.getresponse()
+                        r.read()
+                        status = r.status
+                        break
+                    except OSError:
+                        # a keep-alive connection the server closed
+                        # between requests raises here — one retry on a
+                        # fresh connection absorbs the benign race; a
+                        # second failure is a real error (the retry's
+                        # extra wait charges this request's latency,
+                        # which is the honest accounting)
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", port, timeout=60
+                        )
+                lat = time.monotonic() - due
+                rec = per_class[ci]
+                with lock:
+                    if status == 200:
+                        rec["ok"] += 1
+                        rec["lats"].append(lat)
+                    elif status in (429, 503, 504):
+                        # shed IS the mechanism under measurement: the
+                        # latency of a shed request is meaningless, the
+                        # RATE of shedding is the signal
+                        rec["shed"] += 1
+                    else:
+                        rec["errors"] += 1
+        finally:
+            conn.close()
+
+    threads = [
+        threading.Thread(target=sender, daemon=True, name=f"slo-{i}")
+        for i in range(workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=secs * 4 + 120)
+    # achieved rate over the SCHEDULED window, not sender wall time: a
+    # schedule whose last arrival lands early must not inflate the rate
+    wall = max(secs, time.monotonic() - anchor - 0.05)
+
+    total_ok = sum(c["ok"] for c in per_class)
+    total_shed = sum(c["shed"] for c in per_class)
+    total_err = sum(c["errors"] for c in per_class)
+    sent = total_ok + total_shed + total_err
+    out_classes = {}
+    for c, rec in zip(classes, per_class):
+        out_classes[c["name"]] = {
+            **latency_summary(rec["lats"]),
+            "ok": rec["ok"],
+            "shed": rec["shed"],
+            "errors": rec["errors"],
+            "offered_qps": round(c["rate"], 2),
+        }
+    return {
+        "offered_qps": round(offered, 2),
+        "achieved_qps": round(total_ok / wall, 2) if wall else 0.0,
+        "sent": sent,
+        "shed_rate": round(total_shed / max(sent, 1), 4),
+        "error_rate": round(total_err / max(sent, 1), 4),
+        "max_start_lag_ms": round(max_lag[0] * 1e3, 1),
+        "classes": out_classes,
+    }
+
+
+def detect_knee(steps: list) -> dict | None:
+    """The saturation knee: the first step where the server visibly
+    stopped keeping up — sheds past 1%, or completions under 90% of the
+    offered rate.  None = the sweep never saturated (offer more)."""
+    for s in steps:
+        if s["shed_rate"] > 0.01:
+            return {
+                "offered_qps": s["offered_qps"],
+                "reason": "shed_rate",
+                "shed_rate": s["shed_rate"],
+            }
+        if s["achieved_qps"] < 0.9 * s["offered_qps"]:
+            return {
+                "offered_qps": s["offered_qps"],
+                "reason": "achieved_below_offered",
+                "achieved_qps": s["achieved_qps"],
+            }
+    return None
+
+
+# ------------------------------------------------------------- server arm
+
+class _ServerArm:
+    """Boot a DgraphServer under a pinned env regime, restore on exit —
+    the bench.py save/restore contract, as a context manager."""
+
+    def __init__(self, store, env: dict):
+        self._store = store
+        self._env = env
+        self._saved = {}
+        self.srv = None
+
+    def __enter__(self):
+        for k, v in self._env.items():
+            self._saved[k] = os.environ.get(k)
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            from dgraph_tpu.serve.server import DgraphServer
+
+            self.srv = DgraphServer(self._store)
+            self.srv.start()
+        except BaseException:
+            # a failed boot skips __exit__ (context-manager protocol):
+            # restore HERE or this arm's regime leaks into later arms,
+            # which run_slo_bench's arm isolation would then measure
+            self._restore()
+            raise
+        return self.srv
+
+    def __exit__(self, et, ev, tb):
+        try:
+            self.srv.stop()
+        finally:
+            self._restore()
+
+    def _restore(self):
+        for k, v in self._saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _warmup(port: int, classes: list) -> None:
+    """Untimed compile/cache warmup: one pass over every pool so the
+    first measured step never pays XLA compilation."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        for c in classes:
+            for body in c["pool"][:8]:
+                conn.request("POST", "/query", body=body.encode())
+                conn.getresponse().read()
+    finally:
+        conn.close()
+
+
+# ------------------------------------------------------------------ arms
+
+def run_sweep(store, mix_weights: list, rates, secs, workers, seed) -> dict:
+    """The main arm: the mixed workload swept over offered rates on the
+    production configuration (scheduler + caches + QoS armed)."""
+    sat_stop = _env_f("SLO_SAT_STOP", 0.5)
+    steps = []
+    with _ServerArm(store, {
+        "DGRAPH_TPU_SCHED": "1",
+        "DGRAPH_TPU_CACHE": os.environ.get("SLO_CACHE", "1"),
+    }) as srv:
+        classes = [
+            {**c, "rate": 0.0} for c in mix_weights
+        ]
+        _warmup(srv.port, classes)
+        wsum = sum(c["weight"] for c in classes)
+        for step_i, rate in enumerate(rates):
+            for c in classes:
+                c["rate"] = rate * c["weight"] / wsum
+            step = open_loop_step(
+                srv.port, classes, secs, seed + step_i, workers
+            )
+            steps.append(step)
+            print(
+                f"# slo step: offered={step['offered_qps']} "
+                f"achieved={step['achieved_qps']} "
+                f"shed={step['shed_rate']}",
+                file=sys.stderr,
+            )
+            if step["shed_rate"] > sat_stop:
+                # saturated: further steps only melt the host without
+                # adding curve — record that we stopped, not silence
+                print(
+                    f"# slo sweep stopped at {rate} qps "
+                    f"(shed {step['shed_rate']} > {sat_stop})",
+                    file=sys.stderr,
+                )
+                break
+    return {"steps": steps, "saturation_knee": detect_knee(steps)}
+
+
+def run_qos_arm(store, rates, secs, workers, seed) -> dict:
+    """Victim p999 vs antagonist offered load, QoS on vs off — the
+    PR-11 A/B with the closed-loop ratio replaced by a curve."""
+    victim_rate = _env_f("SLO_VICTIM_RATE", 10.0)
+    rng = np.random.default_rng(seed + 1000)
+    n_nodes = int(_env_f("SLO_NODES", 20_000))
+    victim_pool = [
+        "{ q(func: uid(0x%x)) { c: count(e) } }" % u
+        for u in np.unique(rng.integers(1, n_nodes + 1, size=64))
+    ]
+    antag_pool = []
+    for _ in range(64):
+        seeds = np.unique(rng.integers(1, n_nodes + 1, size=64))
+        ul = ", ".join("0x%x" % u for u in seeds)
+        antag_pool.append(
+            "{ q(func: uid(%s)) { e { e { c: count(e) } } } }" % ul
+        )
+    tenants = json.dumps({
+        "victim": {"weight": 8, "priority": "high"},
+        "antagonist": {
+            "weight": 1, "max_queued": 8, "max_inflight": 1,
+            "priority": "low",
+        },
+    })
+    out = {"victim_offered_qps": victim_rate, "tenants": json.loads(tenants)}
+    for mode, qos in (("qos_on", "1"), ("qos_off", "0")):
+        steps = []
+        with _ServerArm(store, {
+            "DGRAPH_TPU_SCHED": "1",
+            "DGRAPH_TPU_CACHE": "0",  # a cached antagonist stresses nothing
+            "DGRAPH_TPU_QOS": qos,
+            "DGRAPH_TPU_QOS_TENANTS": tenants,
+        }) as srv:
+            classes = [
+                {"name": "victim", "rate": victim_rate,
+                 "pool": victim_pool, "tenant": "victim"},
+                {"name": "antagonist", "rate": 0.0,
+                 "pool": antag_pool, "tenant": "antagonist"},
+            ]
+            _warmup(srv.port, classes)
+            for step_i, rate in enumerate(rates):
+                classes[1]["rate"] = rate
+                step = open_loop_step(
+                    srv.port, classes, secs, seed + 2000 + step_i, workers
+                )
+                v = step["classes"]["victim"]
+                a = step["classes"]["antagonist"]
+                steps.append({
+                    "antagonist_offered_qps": rate,
+                    "victim_p50_ms": v["p50_ms"],
+                    "victim_p99_ms": v["p99_ms"],
+                    "victim_p999_ms": v["p999_ms"],
+                    "victim_ok": v["ok"],
+                    "antagonist_ok": a["ok"],
+                    "antagonist_shed": a["shed"],
+                })
+                print(
+                    f"# slo qos[{mode}] antag={rate} "
+                    f"victim_p999={v['p999_ms']}ms "
+                    f"antag_shed={a['shed']}",
+                    file=sys.stderr,
+                )
+        out[mode] = steps
+    return out
+
+
+def run_ivm_arm(store, secs, workers, seed) -> dict:
+    """Achieved QPS + p99 at a FIXED offered read load while the write
+    rate sweeps — the PR-12 write-rate sweep, open-loop."""
+    read_rate = _env_f("SLO_IVM_RATE", 50.0)
+    write_rates = _env_rates("SLO_IVM_WRITE_RATES", "0,10,25")
+    rng = np.random.default_rng(seed + 3000)
+    n_nodes = int(_env_f("SLO_NODES", 20_000))
+    read_pool = []
+    for _ in range(64):
+        seeds = np.unique(rng.integers(1, n_nodes + 1, size=8))
+        ul = ", ".join("0x%x" % u for u in seeds)
+        read_pool.append("{ q(func: uid(%s)) { e { c: count(e) } } }" % ul)
+    steps = []
+    with _ServerArm(store, {
+        "DGRAPH_TPU_SCHED": "1",
+        "DGRAPH_TPU_CACHE": "1",
+        "DGRAPH_TPU_IVM": "1",
+    }) as srv:
+        classes = [{
+            "name": "read", "rate": read_rate, "pool": read_pool,
+            "tenant": "",
+        }]
+        _warmup(srv.port, classes)
+        for step_i, wr in enumerate(write_rates):
+            stop = threading.Event()
+
+            def writer(rate=wr):
+                if rate <= 0:
+                    return
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", srv.port, timeout=60
+                )
+                i = 0
+                try:
+                    while not stop.is_set():
+                        u = 0x70000 + (i % 97)
+                        i += 1
+                        for verb in ("set", "delete"):
+                            conn.request(
+                                "POST", "/query",
+                                body=(
+                                    "mutation { %s { <0x%x> <e> <0x%x> . } }"
+                                    % (verb, u, u + 1)
+                                ).encode(),
+                            )
+                            conn.getresponse().read()
+                        if stop.wait(1.0 / rate):
+                            return
+                except OSError:
+                    pass
+                finally:
+                    conn.close()
+
+            wt = threading.Thread(target=writer, daemon=True)
+            wt.start()
+            try:
+                step = open_loop_step(
+                    srv.port, classes, secs, seed + 4000 + step_i, workers
+                )
+            finally:
+                stop.set()
+                wt.join(timeout=30)
+            r = step["classes"]["read"]
+            steps.append({
+                "write_rate": wr,
+                "achieved_qps": step["achieved_qps"],
+                "p50_ms": r["p50_ms"],
+                "p99_ms": r["p99_ms"],
+                "p999_ms": r["p999_ms"],
+                "shed_rate": step["shed_rate"],
+            })
+            print(
+                f"# slo ivm write_rate={wr} "
+                f"qps={step['achieved_qps']} p99={r['p99_ms']}ms",
+                file=sys.stderr,
+            )
+    return {"read_offered_qps": read_rate, "steps": steps}
+
+
+# ------------------------------------------------------------------ main
+
+def run_slo_bench() -> dict:
+    import jax
+
+    from dgraph_tpu.obs import device as _device
+
+    _device.install_compile_listener()
+    _device.stamp_build_info()
+    seed = int(_env_f("SLO_SEED", 7))
+    n_nodes = int(_env_f("SLO_NODES", 20_000))
+    deg = int(_env_f("SLO_DEG", 16))
+    secs = _env_f("SLO_STEP_SECONDS", 4.0)
+    workers = int(_env_f("SLO_WORKERS", 32))
+    rates = _env_rates("SLO_RATES", "25,50,100,200,400")
+    rng = np.random.default_rng(seed)
+    store = _serving_store(n_nodes, deg)
+    mix = build_mix(n_nodes, rng)
+
+    sweep = run_sweep(store, mix, rates, secs, workers, seed)
+    qos = None
+    if os.environ.get("SLO_QOS", "1") != "0":
+        try:
+            qos = run_qos_arm(
+                store, _env_rates("SLO_QOS_RATES", "50,200"), secs,
+                workers, seed,
+            )
+        except Exception as e:  # arm isolation: the curve survives
+            qos = {"error": f"{type(e).__name__}: {e}"}
+    ivm = None
+    if os.environ.get("SLO_IVM", "1") != "0":
+        try:
+            ivm = run_ivm_arm(store, secs, workers, seed)
+        except Exception as e:
+            ivm = {"error": f"{type(e).__name__}: {e}"}
+
+    from dgraph_tpu.obs import ledger as _ledgermod
+
+    out = {
+        "metric": "slo_curve",
+        "backend": jax.default_backend(),
+        "nodes": n_nodes,
+        "deg": deg,
+        "step_seconds": secs,
+        "workers": workers,
+        "mix": {c["name"]: c["weight"] for c in mix},
+        "offered_sweep": sweep["steps"],
+        "saturation_knee": sweep["saturation_knee"],
+        "qos": qos,
+        "ivm": ivm,
+        # the serving-path cost account for the whole run (obs/ledger.py):
+        # edges/sec across the sweep is achieved_qps × edges-per-query,
+        # and this is the series it reconciles against
+        "ledger": _ledgermod.aggregate_summary(),
+    }
+    return out
+
+
+def smoke_check(out: dict) -> None:
+    """The CI gate (SLO_SMOKE=1): the harness is well-formed and the
+    physics points the right way — shed rate must be monotone
+    non-decreasing in offered load (small tolerance for scheduler
+    noise at tiny step sizes)."""
+    for key in (
+        "metric", "backend", "offered_sweep", "saturation_knee", "mix",
+    ):
+        assert key in out, f"slo smoke: missing key {key!r}"
+    steps = out["offered_sweep"]
+    assert len(steps) >= 2, "slo smoke: need at least two offered-load steps"
+    for s in steps:
+        assert s["sent"] > 0, "slo smoke: a step sent nothing"
+        assert s["error_rate"] == 0.0, (
+            f"slo smoke: non-shed errors at offered={s['offered_qps']}"
+        )
+        for cls in s["classes"].values():
+            assert cls["p999_ms"] >= cls["p99_ms"] >= cls["p50_ms"] >= 0
+    sheds = [s["shed_rate"] for s in steps]
+    for a, b in zip(sheds, sheds[1:]):
+        assert b >= a - 0.02, (
+            f"slo smoke: shed rate not monotone across offered load "
+            f"({sheds})"
+        )
+
+
+def main() -> None:
+    platform = ensure_backend()
+    print(f"# backend: {platform}", file=sys.stderr)
+    out = run_slo_bench()
+    if os.environ.get("SLO_SMOKE") == "1":
+        smoke_check(out)
+        print("# slo smoke: OK", file=sys.stderr)
+    body = json.dumps(out)
+    print(body)
+    path = os.environ.get("SLO_OUT", "")
+    if path:
+        with open(path, "w") as f:
+            f.write(body + "\n")
+
+
+if __name__ == "__main__":
+    main()

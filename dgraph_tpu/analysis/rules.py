@@ -66,6 +66,8 @@ the how-to-add-a-rule walkthrough.
 from __future__ import annotations
 
 import ast
+import re
+from pathlib import Path
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from dgraph_tpu.analysis.framework import FileContext, Finding, Rule
@@ -934,6 +936,97 @@ class UncheckedHopLoop(Rule):
                 )
 
 
+# -- rule: unregistered-metric ------------------------------------------------
+
+# the MetricsRegistry constructor methods (utils/metrics.py) — the only
+# sanctioned way a dgraph_* series comes into existence
+_METRIC_CTORS = {
+    "counter", "gauge", "func_gauge", "labeled", "multilabeled",
+    "labeled_gauge", "multilabeled_gauge", "histogram",
+    "labeled_histogram",
+}
+
+
+class UnregisteredMetric(Rule):
+    id = "unregistered-metric"
+    doc = (
+        "dgraph_* metric series constructed without a row in the "
+        "docs/deploy.md metric catalog — every exported series must be "
+        "documented where operators build dashboards and alerts, or it "
+        "is dark data with a scrape cost"
+    )
+
+    # lazily-parsed catalog: the backticked dgraph_* names in deploy.md's
+    # "### Metric catalog" section (scoped to the section so prose
+    # elsewhere mentioning a series does not register it).  Tests
+    # override ``catalog_override`` to pin the set.
+    catalog_override: Optional[Set[str]] = None
+    _catalog_cache: Optional[Set[str]] = None
+
+    @classmethod
+    def catalog(cls) -> Set[str]:
+        if cls.catalog_override is not None:
+            return cls.catalog_override
+        if cls._catalog_cache is None:
+            names: Set[str] = set()
+            doc = (
+                Path(__file__).resolve().parents[2]
+                / "docs" / "deploy.md"
+            )
+            if doc.exists():
+                in_section = False
+                for line in doc.read_text(encoding="utf-8").splitlines():
+                    if line.startswith("### Metric catalog"):
+                        in_section = True
+                        continue
+                    if in_section and line.startswith("#"):
+                        break
+                    if in_section:
+                        names.update(
+                            re.findall(r"`(dgraph_[a-z0-9_]+)`", line)
+                        )
+            cls._catalog_cache = names
+        return cls._catalog_cache
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        catalog = self.catalog()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute) and f.attr in _METRIC_CTORS
+            ):
+                continue
+            # the series name is the first positional OR the name=
+            # keyword — a kwarg spelling must not slip the gate
+            a0 = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "name"),
+                None,
+            )
+            if a0 is None:
+                continue
+            if not (
+                isinstance(a0, ast.Constant)
+                and isinstance(a0.value, str)
+                and a0.value.startswith("dgraph_")
+            ):
+                continue
+            name = a0.value
+            # histogram exposition appends _bucket/_sum/_count; the
+            # catalog documents the family name, which is what is
+            # constructed here — exact match is the contract
+            if name not in catalog:
+                yield ctx.finding(
+                    self.id, node,
+                    f"series {name!r} has no row in the docs/deploy.md "
+                    "metric catalog (### Metric catalog): add one — "
+                    "name, type, labels, one-line meaning — or pragma "
+                    "the site with WHY the series is deliberately "
+                    "undocumented",
+                )
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     HostSyncInJit(),
     RecompileHazard(),
@@ -945,4 +1038,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     NakedRouteThreshold(),
     NakedVersionKey(),
     UncheckedHopLoop(),
+    UnregisteredMetric(),
 )

@@ -58,6 +58,7 @@ import numpy as np
 
 from dgraph_tpu import obs
 from dgraph_tpu.cache.core import VersionedLFUCache, env_bytes
+from dgraph_tpu.obs import ledger
 from dgraph_tpu.utils.metrics import (
     QCACHE_HIT_AGE,
     QCACHE_HOP_BYTES,
@@ -125,7 +126,7 @@ class HopCache:
             key = self.key_for(arena, attr, reverse, src)
         sp = obs.current_span()
         if sp is None:  # unsampled hot path: probe only
-            hit, _ev, _nb = self._c.get_ev(key, version)
+            hit, ev, nb = self._c.get_ev(key, version)
         else:
             # sampled: the probe records its outcome (hit/miss/stale) and
             # the stored payload size, so a trace shows WHICH hops the
@@ -136,6 +137,9 @@ class HopCache:
                 cs.set_attr("outcome", ev)
                 if hit is not None:
                     cs.set_attr("bytes", nb)
+        led = ledger.current()
+        if led is not None:
+            led.note_cache("hop", ev, nb or 0)
         if hit is None:
             return None
         value, age = hit

@@ -37,6 +37,7 @@ from typing import Optional, Tuple
 
 from dgraph_tpu import obs
 from dgraph_tpu.cache.core import VersionedLFUCache, env_bytes
+from dgraph_tpu.obs import ledger
 from dgraph_tpu.utils.metrics import (
     QCACHE_HIT_AGE,
     QCACHE_RESULT_BYTES,
@@ -129,7 +130,7 @@ class ResultCache:
         None.  The returned response is SHARED — read-only downstream."""
         sp = obs.current_span()
         if sp is None:  # unsampled hot path: probe only
-            hit, _ev, _nb = self._c.get_ev(request_digest(key), version)
+            hit, ev, nb = self._c.get_ev(request_digest(key), version)
         else:
             # sampled: a tier-2 hit is the single most latency-deciding
             # event a request can have — the span says so explicitly
@@ -140,6 +141,12 @@ class ResultCache:
                 cs.set_attr("outcome", ev)
                 if hit is not None:
                     cs.set_attr("bytes", nb)
+        led = ledger.current()
+        if led is not None:
+            # a tier-2 hit is the whole request's account: no engine
+            # numbers ever merge in, so the cost story reads "served
+            # from cache for free", which is the truth
+            led.note_cache("result", ev, nb or 0)
         if hit is None:
             return None
         value, age = hit

@@ -31,6 +31,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from dgraph_tpu import ops
+from dgraph_tpu.obs import ledger as _ledger
+from dgraph_tpu.utils.metrics import ARENA_EVICTIONS
 from dgraph_tpu.ops.sets import SENT
 from dgraph_tpu import tok as tokmod
 from dgraph_tpu.models.store import PostingStore
@@ -499,6 +501,9 @@ class CSRArena:
                 )
                 if repaired is not None:
                     IVM_REPAIR_EDGES.add(len(adds) + len(dels))
+                    led = _ledger.current()
+                    if led is not None:
+                        led.repairs += 1
             self._tiles = repaired
         self._device_stale = True
 
@@ -549,6 +554,13 @@ class CSRArena:
             self.offsets = fresh.offsets
             self.dst = fresh.dst
             self._device_stale = False
+            led = _ledger.current()
+            if led is not None:
+                # the re-upload is this request's staging cost: the CSR
+                # triple just crossed host→device on its behalf
+                led.bytes_h2d += int(
+                    self.src.nbytes + self.offsets.nbytes + self.dst.nbytes
+                )
 
 
 def _ivm_repair_gate(n_delta: int, entry_edges: float) -> bool:
@@ -899,6 +911,52 @@ class ArenaManager:
                     self._sharded.pop(skey, None)
                     self._lru_drop(self._sharded, skey)
             self.evictions += 1
+            ARENA_EVICTIONS.add(1)
+
+    def residency(self) -> dict:
+        """HBM-residency + program-cache snapshot (obs/device.py's data
+        source).  ``resident_bytes`` is the budget accountant's running
+        total — the same number eviction decisions are made on — so the
+        telemetry can never disagree with the enforcement.  Program
+        counts walk the cached data/reverse arenas' lazily-attached
+        expanders/tile sets; the walk is O(cached predicates), debug-
+        endpoint cost, never hot-path."""
+        with self._cache_lock:
+            resident = self._lru_total
+            entries = len(self._lru)
+            evictions = self.evictions
+            arenas = list(self._data.values()) + list(
+                self._reverse.values()
+            )
+        tile_bytes = 0
+        tile_sets = 0
+        classed = 0
+        classed_programs = 0
+        for a in arenas:
+            pt = getattr(a, "_tiles", None)
+            if pt is not None:
+                tile_bytes += pt.device_bytes()
+                tile_sets += 1
+            ce = getattr(a, "_classed", None)
+            if ce is not None:
+                classed += 1
+                classed_programs += len(ce._programs)
+        return {
+            "resident_bytes": resident,
+            "budget_bytes": self.budget_bytes,
+            "headroom_bytes": (
+                max(0, self.budget_bytes - resident)
+                if self.budget_bytes else None
+            ),
+            "entries": entries,
+            "evictions": evictions,
+            "tile_bytes": tile_bytes,
+            "program_caches": {
+                "classed_expanders": classed,
+                "classed_programs": classed_programs,
+                "tile_sets": tile_sets,
+            },
+        }
 
     @_cache_locked
     def refresh(self):
@@ -1063,6 +1121,12 @@ class ArenaManager:
         if repaired:
             IVM_REPAIRS.add(("hop", "repaired"))
             IVM_REPAIR_EDGES.add((len(adds) + len(dels)) * repaired)
+            led = _ledger.current()
+            if led is not None:
+                # attributed to the request whose refresh drove the
+                # repair (usually the mutation; sometimes the first
+                # reader after it — same attribution rule as spans)
+                led.repairs += repaired
         if dropped:
             IVM_REPAIRS.add(("hop", "rebuild"))
 

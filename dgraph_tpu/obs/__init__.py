@@ -7,6 +7,7 @@ Chrome ``trace_event`` export.  See obs/spans.py for the design and
 docs/deploy.md for the operator surface.
 """
 
+from dgraph_tpu.obs import device, ledger  # noqa: F401 — submodule surface
 from dgraph_tpu.obs.export import chrome_trace
 from dgraph_tpu.obs.spans import (
     NOOP,

@@ -1,0 +1,118 @@
+"""Device/HBM telemetry: residency, program caches, compile events.
+
+The ArenaManager already *enforces* an HBM budget (models/arena.py LRU
+eviction) and the ops layer already *bounds* its program caches
+(ClassedExpander shape families, per-arena spgemm tile sets) — but none
+of that state was visible to an operator except by reading code.  This
+module turns the enforcement bookkeeping into gauges and one snapshot
+endpoint:
+
+- **HBM residency** — resident bytes vs budget (headroom is the
+  difference), dense join-tile bytes, cumulative arena evictions;
+- **program caches** — live ClassedExpander program counts and tile-set
+  counts per kind (`dgraph_program_cache_entries{kind}`), the occupancy
+  side of the compile-budget guards tests already enforce;
+- **XLA compile events** — every backend compilation via the same
+  ``jax.monitoring`` event the per-test compile budgets count
+  (`/jax/core/compile/backend_compile_duration`), as a process counter
+  + duration histogram, and onto the active request's ledger so a
+  compile-storm query is attributable;
+- **build identity** — `dgraph_build_info{version,backend,jax}` = 1,
+  stamped once the backend is known.
+
+Served at ``GET /debug/device`` and folded into ``GET /debug/bundle``
+(serve/server.py) — the single-request postmortem JSON.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from dgraph_tpu.obs import ledger as _ledger
+from dgraph_tpu.utils.metrics import (
+    BUILD_INFO,
+    HBM_BUDGET_BYTES,
+    HBM_RESIDENT_BYTES,
+    HBM_TILE_BYTES,
+    PROGRAM_CACHE_ENTRIES,
+    XLA_COMPILE_SECONDS,
+    XLA_COMPILES,
+)
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_install_lock = threading.Lock()
+_installed = False
+
+
+def _on_event_duration(name: str, secs: float, **kw) -> None:
+    if name != _COMPILE_EVENT:
+        return
+    XLA_COMPILES.add(1)
+    XLA_COMPILE_SECONDS.observe(secs)
+    led = _ledger.current()
+    if led is not None:
+        # compiles land on whichever request's thread triggered them —
+        # per-request attribution, with the same caveat the per-test
+        # compile budgets document for worker threads
+        led.compiles += 1
+
+
+def install_compile_listener() -> None:
+    """Register the jax.monitoring compile listener (idempotent; safe
+    to call from every server boot and every bench harness)."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration
+        )
+        _installed = True
+
+
+def stamp_build_info() -> None:
+    """Publish dgraph_build_info{version,backend,jax} = 1.  Reads the
+    default backend, so call it AFTER jax platform selection settled
+    (server start / harness boot)."""
+    import jax
+
+    from dgraph_tpu import __version__
+
+    BUILD_INFO.set(
+        (__version__, jax.default_backend(), jax.__version__), 1.0
+    )
+
+
+def snapshot(server=None) -> dict:
+    """One device-telemetry snapshot (the /debug/device body), updating
+    the gauges as a side effect so a scrape that never hits the debug
+    endpoint still sees fresh residency numbers after any snapshot.
+
+    ``server`` is a DgraphServer when called from the serving surface;
+    None degrades to the process-wide (backend + compile) view."""
+    import jax
+
+    out: dict = {
+        "backend": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "jax": jax.__version__,
+        "compiles": {
+            "total": XLA_COMPILES.value(),
+            "seconds_sum": round(XLA_COMPILE_SECONDS.snapshot()[1], 3),
+        },
+    }
+    if server is None:
+        return out
+    arenas = getattr(server.engine, "arenas", None)
+    if arenas is not None:
+        res = arenas.residency()
+        HBM_RESIDENT_BYTES.set(res["resident_bytes"])
+        HBM_BUDGET_BYTES.set(res["budget_bytes"])
+        HBM_TILE_BYTES.set(res["tile_bytes"])
+        for kind, n in res["program_caches"].items():
+            PROGRAM_CACHE_ENTRIES.set(kind, n)
+        out["arenas"] = res
+    return out

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from dgraph_tpu import gql, ivm, obs, ops
+from dgraph_tpu.obs import ledger as _ledger
 from dgraph_tpu.gql.ast import (
     FilterTree,
     Function,
@@ -187,7 +188,13 @@ class DeviceExpander:
         fail.point("engine.hop")
         sp = obs.current_span()
         if sp is None:  # unsampled hot path: zero allocations, async dispatch
-            return self._expand_cached(arena, src, attr, reverse)
+            out, seg_ptr = self._expand_cached(arena, src, attr, reverse)
+            led = _ledger.current()
+            if led is not None:
+                # one dict bump per hop on the pooled struct — the
+                # ledger's whole unsampled footprint at this seam
+                led.note_hop(self._route or "csr")
+            return out, seg_ptr
         st = self.engine.stats
         e0, d0, h0 = st["edges"], st["device_expand_ms"], st["host_expand_ms"]
         self._route = ""
@@ -209,6 +216,9 @@ class DeviceExpander:
                 hs.set_attr("device_ms", round(dm, 3))
             if hm:
                 hs.set_attr("host_ms", round(hm, 3))
+        led = _ledger.current()
+        if led is not None:
+            led.note_hop(self._route or "csr")
         return out, seg_ptr
 
     def _expand_cached(
@@ -338,6 +348,10 @@ class DeviceExpander:
                     eng.arenas.mesh, sharded, src, cap
                 )
             eng.stats["edges"] += len(out)
+            led = _ledger.current()
+            if led is not None:
+                led.bytes_h2d += int(src.nbytes)
+                led.bytes_d2h += int(out.nbytes + seg_ptr.nbytes)
             return out, seg_ptr
         # host-vs-device: calibrated break-even by default (the
         # size-adaptive routing the reference does per-intersection,
@@ -373,6 +387,10 @@ class DeviceExpander:
                     rows, arena.degree_of_rows(rows)
                 )
             eng.stats["edges"] += len(out)
+            led = _ledger.current()
+            if led is not None:
+                led.bytes_h2d += int(rows.nbytes)
+                led.bytes_d2h += int(out.nbytes + seg_ptr.nbytes)
             return out, seg_ptr
         if ascending:
             self._route = "inline"
@@ -389,11 +407,19 @@ class DeviceExpander:
                     # sampled: split pure device time from the host fetch
                     # (the unsampled path stays dispatch-async — asarray
                     # overlaps the compute with the host bookkeeping)
+                    sync_ms = obs.block_ready_ms(dev)
                     self._span.set_attr(
-                        "device_sync_ms", round(obs.block_ready_ms(dev), 3)
+                        "device_sync_ms", round(sync_ms, 3)
                     )
+                    led = _ledger.current()
+                    if led is not None:
+                        led.device_sync_ms += sync_ms
                 # one fetch: inline|ov|ovseg concatenated on device
                 packed = np.asarray(dev)
+            led = _ledger.current()
+            if led is not None:
+                led.bytes_h2d += int(rows.nbytes)
+                led.bytes_d2h += int(packed.nbytes)
             from dgraph_tpu.query.chain import packed_inline_to_matrix
 
             out, seg_ptr = packed_inline_to_matrix(packed, B, capov, n)
@@ -407,11 +433,19 @@ class DeviceExpander:
                 ops.pad_rows(rows, ops.bucket(n)), cap,
             )
             if self._span is not None:
+                sync_ms = obs.block_ready_ms(dev)
                 self._span.set_attr(
-                    "device_sync_ms", round(obs.block_ready_ms(dev), 3)
+                    "device_sync_ms", round(sync_ms, 3)
                 )
+                led = _ledger.current()
+                if led is not None:
+                    led.device_sync_ms += sync_ms
             # one fetch: out|seg concatenated on device
             packed = np.asarray(dev)
+        led = _ledger.current()
+        if led is not None:
+            led.bytes_h2d += int(rows.nbytes)
+            led.bytes_d2h += int(packed.nbytes)
         out = packed[:total].astype(np.int64)
         seg = packed[cap : cap + total].astype(np.int64)
         counts = np.bincount(seg, minlength=n)

@@ -125,7 +125,7 @@ class SchedRequest:
     __slots__ = (
         "parsed", "debug", "deadline", "enqueued", "key",
         "_done", "result", "stats", "error", "span", "queue_span",
-        "tenant", "cancel",
+        "tenant", "cancel", "ledger",
     )
 
     def __init__(self, parsed, debug: bool = False,
@@ -154,6 +154,11 @@ class SchedRequest:
         # request's fate — execution, shed, or singleflight dealing.
         self.span = None
         self.queue_span = None
+        # per-query resource ledger (obs/ledger.py): the admitting
+        # request's pooled account, re-activated on whichever flush
+        # worker executes it (None when DGRAPH_TPU_LEDGER=0 — then the
+        # slot costs one None store and is never read)
+        self.ledger = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and (

@@ -84,6 +84,7 @@ from typing import Dict, List, Optional
 
 from dgraph_tpu import ivm as _ivm
 from dgraph_tpu import obs
+from dgraph_tpu.obs import ledger as _ledgermod
 from dgraph_tpu.sched import qos as _qos
 from dgraph_tpu.sched.cohort import (
     Cohort,
@@ -295,6 +296,10 @@ class CohortScheduler:
             # latency map filed under an undifferentiated "processing"
             req.span = sp
             req.queue_span = sp.child("sched.queue")
+        # the resource ledger rides the same thread hop as the span: the
+        # handler thread owns it again once wait() returns (obs/ledger.py
+        # single-writer hand-off)
+        req.ledger = _ledgermod.current()
         try:
             self._admit(req, sig, key)
         except SchedOverloadError:
@@ -641,6 +646,11 @@ class CohortScheduler:
                         if lead.error is None:
                             # results are read-only from here on
                             # (handlers only encode them): sharing is safe
+                            if req.ledger is not None:
+                                # dealt a twin's result: the follower's
+                                # account says "coalesced", never the
+                                # leader's engine numbers twice
+                                req.ledger.coalesced += 1
                             req.complete(lead.result, lead.stats)
                         elif isinstance(lead.error, SchedDeadlineError):
                             # the leader ran out of budget but this
@@ -702,6 +712,8 @@ class CohortScheduler:
         if req.result is not None or req.error is not None:
             return
         if lead.error is None:
+            if req.ledger is not None:
+                req.ledger.coalesced += 1
             req.complete(lead.result, lead.stats)
         elif isinstance(lead.error, SchedDeadlineError) and not req.expired():
             # leader ran out of budget but this twin still has some: run
@@ -727,6 +739,7 @@ class CohortScheduler:
         from dgraph_tpu.query.engine import QueryEngine
 
         srv = self._server
+        ltoken = None
         try:
             if req.expired():
                 # budget lapsed while the cohort waited on the engine
@@ -740,11 +753,13 @@ class CohortScheduler:
                 return
             req.end_queue_wait("run")
             # re-root this worker thread under the admitting request's
-            # trace: the engine span parents to the REQUEST (it is that
-            # query's execution) and LINKS to the shared cohort-flush
-            # span that scheduled it — merged work attributed without
-            # being claimed twice
+            # trace AND ledger: the engine span parents to the REQUEST
+            # (it is that query's execution) and LINKS to the shared
+            # cohort-flush span that scheduled it — merged work
+            # attributed without being claimed twice
             es = obs.NOOP
+            if req.ledger is not None:
+                ltoken = _ledgermod.activate(req.ledger)
             if req.span is not None:
                 es = req.span.child("engine")
                 if flush_span is not None:
@@ -765,10 +780,17 @@ class CohortScheduler:
                 es.set_attr("edges", eng.stats.get("edges", 0))
             if srv.dumpsg_path and eng.last_dump:
                 srv._dump_subgraphs(eng.last_dump)
+            if req.ledger is not None:
+                # fold this shell's stats in BEFORE completion: once
+                # complete() fires, the handler thread owns the ledger
+                # again (the single-writer hand-off)
+                req.ledger.merge_engine_stats(eng.stats)
             req.complete(out, dict(eng.stats))
         except BaseException as e:  # noqa: BLE001 — delivered via req.fail
             req.fail(e)
         finally:
+            if ltoken is not None:
+                _ledgermod.deactivate(ltoken)
             merger.leave()
 
     # -- introspection -----------------------------------------------------

@@ -24,6 +24,8 @@ from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from dgraph_tpu import obs
+from dgraph_tpu.obs import device as _device
+from dgraph_tpu.obs import ledger as _ledger
 from dgraph_tpu.models.durability import ReadOnlyError, StorageFaultError
 from dgraph_tpu.models.store import PostingStore
 from dgraph_tpu.query.engine import QueryEngine
@@ -234,6 +236,11 @@ class DgraphServer:
             self.snapshotter.start()
         if self.subs is not None:
             self.subs.start()
+        # device telemetry (obs/device.py): compile-event listener +
+        # build-identity stamp — by start() the jax platform is settled
+        # (the engine's arenas forced backend selection in __init__)
+        _device.install_compile_listener()
+        _device.stamp_build_info()
         self.health.set_ok(True)
 
     @property
@@ -292,6 +299,7 @@ class DgraphServer:
         trace_ctx=None,
         tenant: str = "",
         cancel_probe=None,
+        ledger_out: bool = False,
     ) -> dict:
         """The ParseQueryAndMutation → ProcessWithMutation → encode path
         with the reference's latency breakdown (query/query.go:102).
@@ -340,6 +348,12 @@ class DgraphServer:
             if qos_on:
                 root.set_attr("tenant", tenant)
             root.__enter__()  # paired with __exit__ in the finally below
+        # per-query resource ledger (obs/ledger.py): one pooled struct
+        # for this request's whole serving path; None under
+        # DGRAPH_TPU_LEDGER=0, and then every downstream site is a dead
+        # None-check — the byte-identical off switch
+        led = _ledger.start(tenant)
+        ltoken = _ledger.activate(led) if led is not None else None
         try:
             with obs.child("parsing"):
                 parsed = gql.parse(text, variables)
@@ -415,6 +429,12 @@ class DgraphServer:
             # latency map is complete before attaching it
             lat.record_json()
             out["server_latency"] = lat.to_map()
+            if ledger_out and led is not None:
+                # explicit opt-in surface (?ledger=true): the account in
+                # the response extensions, the Dgraph convention for
+                # out-of-band response metadata.  Default responses (any
+                # gate state) never carry the key.
+                out.setdefault("extensions", {})["ledger"] = led.to_dict()
             if debug:
                 # per-stage engine breakdown (device vs host vs fused
                 # chain time + edges traversed) — the per-query profile
@@ -446,6 +466,14 @@ class DgraphServer:
         finally:
             PENDING_QUERIES.add(-1)
             dur = time.monotonic() - t0
+            if led is not None:
+                # drain to the per-tenant/per-route series and recycle
+                # the struct; a sampled trace carries the same account
+                # as a root attr (before __exit__ publishes it)
+                _ledger.deactivate(ltoken)
+                summary = _ledger.finish(led)
+                if root is not None:
+                    root.set_attr("ledger", summary)
             trace_id = root.trace_id if root is not None else None
             if root is not None:
                 if token is not None:
@@ -510,6 +538,9 @@ class DgraphServer:
                     eng.chain_threshold = self.engine.chain_threshold
                 eng.dump_shapes = bool(self.dumpsg_path)
                 out.update(eng.run_parsed(parsed))
+                led = _ledger.current()
+                if led is not None:
+                    led.merge_engine_stats(eng.stats)
                 if self.dumpsg_path and eng.last_dump:
                     self._dump_subgraphs(eng.last_dump)
             finally:
@@ -649,6 +680,42 @@ def _make_handler(srv: DgraphServer):
                 # process-wide
                 stats["join"] = joinplan.debug_summary()
                 self._reply(200, json.dumps(stats).encode())
+            elif path == "/debug/device":
+                # device/HBM telemetry snapshot (obs/device.py): backend
+                # identity, HBM residency vs budget, program-cache
+                # occupancy, compile-event totals — and the gauges
+                # refresh as a side effect of the snapshot
+                self._reply(200, json.dumps(_device.snapshot(srv)).encode())
+            elif path == "/debug/bundle":
+                # ONE postmortem JSON: everything an operator pastes
+                # into an incident doc — traces ring + slow queries +
+                # planner/join rings + qos + ivm + device + ledger
+                # aggregates, snapshotted together so the pieces are
+                # mutually consistent to within one scrape
+                from dgraph_tpu.obs import ledger as _ledgermod
+                from dgraph_tpu.query import planner as _planner
+
+                rec = obs.get_recorder()
+                bundle = {
+                    "generated_unix": time.time(),
+                    "traces": rec.traces() if srv.expose_trace else None,
+                    "slow_queries": (
+                        rec.slow_queries() if srv.expose_trace else None
+                    ),
+                    "planner": _planner.debug_summary(
+                        scheduler=srv.scheduler
+                    ),
+                    "qos": (
+                        srv.scheduler.qos_state()
+                        if srv.scheduler is not None
+                        else None
+                    ),
+                    "ivm": _ivm_stats(srv),
+                    "qcache": _qcache_stats(srv),
+                    "device": _device.snapshot(srv),
+                    "ledger": _ledgermod.aggregate_summary(),
+                }
+                self._reply(200, json.dumps(bundle, default=str).encode())
             elif path == "/debug/planner":
                 # the unified route-decision view (query/planner.py):
                 # calibration provenance + live rates, per-(kind,route)
@@ -1057,6 +1124,10 @@ def _make_handler(srv: DgraphServer):
             if u.path == "/query":
                 qs = parse_qs(u.query)
                 debug = qs.get("debug", ["false"])[0] == "true"
+                # ?ledger=true: return the per-query resource account in
+                # the response extensions (obs/ledger.py; no-op when
+                # DGRAPH_TPU_LEDGER=0)
+                want_ledger = qs.get("ledger", ["false"])[0] == "true"
                 try:
                     vars_hdr = self.headers.get("X-Dgraph-Vars")
                     variables = json.loads(vars_hdr) if vars_hdr else None
@@ -1077,6 +1148,7 @@ def _make_handler(srv: DgraphServer):
                         trace_ctx=tctx,
                         tenant=self.headers.get("X-Dgraph-Tenant") or "",
                         cancel_probe=self._disconnect_probe(),
+                        ledger_out=want_ledger,
                     )
                     accept = self.headers.get("Accept", "")
                     if "application/protobuf" in accept or "application/x-protobuf" in accept:

@@ -9,6 +9,7 @@ queries/proposals, per-predicate mutation counts (task.go PredicateStats).
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Dict, Optional
 
 
@@ -105,6 +106,48 @@ class MultiLabeledCounter:
             if all(key[idx[l]] == str(val) for l, val in want.items()):
                 out += v
         return out
+
+
+class FuncGauge:
+    """Gauge whose value is computed at scrape time (process uptime,
+    anything derived from a live clock).  The callable must be cheap and
+    exception-free — it runs inside every exposition pass."""
+
+    __slots__ = ("name", "_fn")
+
+    def __init__(self, name: str, fn):
+        self.name = name
+        self._fn = fn
+
+    def value(self) -> float:
+        return float(self._fn())
+
+
+class MultiLabeledGauge:
+    """Gauge family keyed by a label TUPLE — ``dgraph_build_info`` is
+    the canonical user: a constant-1 gauge whose labels carry the
+    version/backend identity (the prometheus client_golang BuildInfo
+    convention), which a single-label gauge cannot express."""
+
+    def __init__(self, name: str, labels):
+        self.name = name
+        self.labels = tuple(labels)
+        self._m: Dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, key, v: float) -> None:
+        key = tuple(str(k) for k in key)
+        if len(key) != len(self.labels):
+            raise ValueError(
+                f"{self.name}: expected {len(self.labels)} label values, "
+                f"got {len(key)}"
+            )
+        with self._lock:
+            self._m[key] = float(v)
+
+    def snapshot(self) -> Dict[tuple, float]:
+        with self._lock:
+            return dict(self._m)
 
 
 class LabeledGauge:
@@ -251,9 +294,11 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
+        self._func_gauges: Dict[str, FuncGauge] = {}
         self._labeled: Dict[str, LabeledCounter] = {}
         self._multilabeled: Dict[str, MultiLabeledCounter] = {}
         self._labeled_gauges: Dict[str, LabeledGauge] = {}
+        self._multilabeled_gauges: Dict[str, MultiLabeledGauge] = {}
         self._histograms: Dict[str, Histogram] = {}
         self._labeled_histograms: Dict[str, LabeledHistogram] = {}
 
@@ -269,6 +314,13 @@ class MetricsRegistry:
             g = self._gauges.get(name)
             if g is None:
                 g = self._gauges[name] = Gauge(name)
+            return g
+
+    def func_gauge(self, name: str, fn) -> FuncGauge:
+        with self._lock:
+            g = self._func_gauges.get(name)
+            if g is None:
+                g = self._func_gauges[name] = FuncGauge(name, fn)
             return g
 
     def labeled(self, name: str, label: str = "predicate") -> LabeledCounter:
@@ -290,6 +342,15 @@ class MetricsRegistry:
             g = self._labeled_gauges.get(name)
             if g is None:
                 g = self._labeled_gauges[name] = LabeledGauge(name, label)
+            return g
+
+    def multilabeled_gauge(self, name: str, labels) -> MultiLabeledGauge:
+        with self._lock:
+            g = self._multilabeled_gauges.get(name)
+            if g is None:
+                g = self._multilabeled_gauges[name] = MultiLabeledGauge(
+                    name, labels
+                )
             return g
 
     def histogram(self, name: str, buckets) -> Histogram:
@@ -317,9 +378,11 @@ class MetricsRegistry:
         with self._lock:
             counters = list(self._counters.values())
             gauges = list(self._gauges.values())
+            func_gauges = list(self._func_gauges.values())
             labeled = list(self._labeled.values())
             multilabeled = list(self._multilabeled.values())
             labeled_gauges = list(self._labeled_gauges.values())
+            multilabeled_gauges = list(self._multilabeled_gauges.values())
             histograms = list(self._histograms.values())
             labeled_histograms = list(self._labeled_histograms.values())
 
@@ -332,6 +395,9 @@ class MetricsRegistry:
         for g in sorted(gauges, key=lambda g: g.name):
             lines.append(f"# TYPE {g.name} gauge")
             lines.append(f"{g.name} {g.value()}")
+        for fg in sorted(func_gauges, key=lambda g: g.name):
+            lines.append(f"# TYPE {fg.name} gauge")
+            lines.append(f"{fg.name} {fg.value():g}")
         for l in sorted(labeled, key=lambda l: l.name):
             lines.append(f"# TYPE {l.name} counter")
             for k, v in sorted(l.snapshot().items()):
@@ -347,6 +413,13 @@ class MetricsRegistry:
             lines.append(f"# TYPE {lg.name} gauge")
             for k, v in sorted(lg.snapshot().items()):
                 lines.append(f'{lg.name}{{{lg.label}="{_esc(k)}"}} {v:g}')
+        for mg in sorted(multilabeled_gauges, key=lambda g: g.name):
+            lines.append(f"# TYPE {mg.name} gauge")
+            for key, v in sorted(mg.snapshot().items()):
+                pairs = ",".join(
+                    f'{lab}="{_esc(val)}"' for lab, val in zip(mg.labels, key)
+                )
+                lines.append(f"{mg.name}{{{pairs}}} {v:g}")
         for h in sorted(histograms, key=lambda h: h.name):
             cum, s, c = h.snapshot()
             lines.append(f"# TYPE {h.name} histogram")
@@ -626,6 +699,66 @@ SUBS_EVENTS = metrics.labeled(
 )
 SUBS_SHED = metrics.labeled(
     "dgraph_subscription_shed_total", label="reason"
+)
+
+
+# per-query resource ledger (obs/ledger.py): the serving-path cost
+# accounting the SLO layer aggregates.  EDGES_TRAVERSED{tenant} makes
+# the BASELINE north-star metric (edges traversed per second) a live
+# per-tenant series instead of a bench artifact; LEDGER_HOPS{route}
+# counts hop dispatches by the route the expander took
+# (cache/merged/mesh/host/classed/inline/csr/chain/mxu);
+# LEDGER_STAGE_US{stage} accumulates host/device/device_sync time in
+# integer microseconds; LEDGER_BYTES{dir} the staged h2d/d2h bytes and
+# cache-hit payload bytes.  LEDGERS_CREATED counts Ledger STRUCTS
+# constructed — the pooled-struct twin of dgraph_trace_spans_total:
+# tests assert a zero delta across warm requests, so "one pooled struct
+# per request, zero allocations" is a counter-proved property, not a
+# hope.
+EDGES_TRAVERSED = metrics.labeled(
+    "dgraph_edges_traversed_total", label="tenant"
+)
+LEDGER_HOPS = metrics.labeled("dgraph_ledger_hops_total", label="route")
+LEDGER_STAGE_US = metrics.labeled(
+    "dgraph_ledger_stage_us_total", label="stage"
+)
+LEDGER_BYTES = metrics.labeled("dgraph_ledger_bytes_total", label="dir")
+LEDGERS_CREATED = metrics.counter("dgraph_ledger_structs_total")
+
+
+# device telemetry (obs/device.py + models/arena.py): HBM residency
+# under the ArenaManager budget (resident/budget gauges — headroom is
+# the difference, computed in PromQL, not stored), dense join-tile
+# residency, arena LRU evictions, bounded program-cache occupancy per
+# kind (classed-expander programs, tile sets), and XLA compile events
+# via jax.monitoring (count + seconds as a histogram, so compile storms
+# show up as a rate AND a duration distribution).
+HBM_RESIDENT_BYTES = metrics.gauge("dgraph_hbm_resident_bytes")
+HBM_BUDGET_BYTES = metrics.gauge("dgraph_hbm_budget_bytes")
+HBM_TILE_BYTES = metrics.gauge("dgraph_hbm_tile_bytes")
+ARENA_EVICTIONS = metrics.counter("dgraph_arena_evictions_total")
+PROGRAM_CACHE_ENTRIES = metrics.labeled_gauge(
+    "dgraph_program_cache_entries", label="kind"
+)
+XLA_COMPILES = metrics.counter("dgraph_xla_compiles_total")
+XLA_COMPILE_SECONDS = metrics.histogram(
+    "dgraph_xla_compile_seconds",
+    (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+)
+
+
+# build identity + liveness: BUILD_INFO is the constant-1 gauge whose
+# labels carry what is running (the client_golang BuildInfo
+# convention; obs/device.py stamps it once the backend is known), and
+# UPTIME computes seconds-since-import at scrape time — a FuncGauge,
+# so no background thread exists just to tick a number.
+BUILD_INFO = metrics.multilabeled_gauge(
+    "dgraph_build_info", ("version", "backend", "jax")
+)
+_PROCESS_START = _time.monotonic()
+UPTIME_SECONDS = metrics.func_gauge(
+    "dgraph_uptime_seconds",
+    lambda: _time.monotonic() - _PROCESS_START,
 )
 
 

@@ -642,6 +642,60 @@ def test_unchecked_hop_loop_counterexamples_clean():
     ) == []
 
 
+def test_unregistered_metric_flagged():
+    """Golden-bad: a dgraph_* series with no docs/deploy.md catalog row
+    must be flagged — and the catalog is pinned for the test so the
+    verdict cannot drift with the doc."""
+    from dgraph_tpu.analysis.rules import UnregisteredMetric
+
+    UnregisteredMetric.catalog_override = {"dgraph_num_queries_total"}
+    try:
+        bad = textwrap.dedent("""
+            from dgraph_tpu.utils.metrics import metrics
+
+            ROGUE = metrics.counter("dgraph_totally_new_series_total")
+            ROGUE_H = metrics.histogram("dgraph_rogue_seconds", (0.1, 1))
+            ROGUE_KW = metrics.counter(name="dgraph_kwarg_series_total")
+        """)
+        assert _ids(check_source(bad, [UnregisteredMetric()])) == [
+            "unregistered-metric", "unregistered-metric",
+            "unregistered-metric",
+        ]
+        # counterexample: a cataloged series is clean, and non-dgraph
+        # names (third-party prefixes) are out of scope
+        good = textwrap.dedent("""
+            from dgraph_tpu.utils.metrics import metrics
+
+            NQ = metrics.counter("dgraph_num_queries_total")
+            OTHER = metrics.counter("python_gc_collections_total")
+        """)
+        assert check_source(good, [UnregisteredMetric()]) == []
+        # pragma escape hatch with the WHY
+        pragmad = textwrap.dedent("""
+            from dgraph_tpu.utils.metrics import metrics
+
+            # internal-only A/B probe, removed with the experiment
+            # graftlint: ignore[unregistered-metric]
+            EXP = metrics.counter("dgraph_experiment_total")
+        """)
+        assert check_source(pragmad, [UnregisteredMetric()]) == []
+    finally:
+        UnregisteredMetric.catalog_override = None
+
+
+def test_unregistered_metric_real_catalog_parses():
+    """The real deploy.md catalog section must parse to a non-trivial
+    set containing the anchor series (guards against a doc refactor
+    silently emptying the rule's ground truth)."""
+    from dgraph_tpu.analysis.rules import UnregisteredMetric
+
+    UnregisteredMetric._catalog_cache = None
+    cat = UnregisteredMetric.catalog()
+    assert "dgraph_num_queries_total" in cat
+    assert "dgraph_edges_traversed_total" in cat
+    assert len(cat) > 40
+
+
 def test_unchecked_hop_loop_nested_checkpoint_covers_outer():
     # a checkpoint in the innermost loop satisfies every enclosing loop
     # (the outer iteration cannot advance without passing through it)

@@ -1,0 +1,119 @@
+"""Open-loop SLO harness (bench_slo.py): the schedule, percentile and
+knee-detection machinery as units, plus one micro end-to-end step
+against a live server — the CI smoke job (.github/workflows/ci.yml,
+`slo`) runs the full harness; these keep the pieces honest at tier-1
+speed."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import bench_slo  # noqa: E402
+
+
+def test_poisson_schedule_rate_and_determinism():
+    rng = np.random.default_rng(7)
+    a = bench_slo.poisson_schedule(100.0, 10.0, rng)
+    # a seeded draw is reproducible
+    b = bench_slo.poisson_schedule(100.0, 10.0, np.random.default_rng(7))
+    assert np.array_equal(a, b)
+    # rate*secs arrivals to within Poisson noise (σ ≈ √1000 ≈ 32)
+    assert 850 < len(a) < 1150
+    # offsets ascend and stay inside the window
+    assert np.all(np.diff(a) >= 0)
+    assert a[-1] < 10.0
+
+
+def test_pctile_and_summary():
+    lats = [i / 1000.0 for i in range(1, 101)]  # 1..100 ms
+    assert bench_slo.pctile(lats, 0.50) == pytest.approx(51.0, abs=1.0)
+    assert bench_slo.pctile(lats, 0.99) == pytest.approx(99.0, abs=1.0)
+    s = bench_slo.latency_summary(lats)
+    assert s["n"] == 100
+    assert s["p50_ms"] <= s["p99_ms"] <= s["p999_ms"]
+    assert bench_slo.latency_summary([]) == {
+        "n": 0, "p50_ms": 0.0, "p99_ms": 0.0, "p999_ms": 0.0,
+    }
+
+
+def test_detect_knee():
+    mk = lambda off, ach, shed: {
+        "offered_qps": off, "achieved_qps": ach, "shed_rate": shed,
+    }
+    # clean run: no knee
+    assert bench_slo.detect_knee([mk(50, 50, 0.0), mk(100, 99, 0.005)]) is None
+    # shed knee at the second step
+    knee = bench_slo.detect_knee(
+        [mk(50, 50, 0.0), mk(100, 92, 0.08), mk(200, 90, 0.5)]
+    )
+    assert knee == {
+        "offered_qps": 100, "reason": "shed_rate", "shed_rate": 0.08,
+    }
+    # throughput knee: completions fall under 90% of offered with no sheds
+    knee = bench_slo.detect_knee([mk(50, 50, 0.0), mk(200, 120, 0.0)])
+    assert knee["reason"] == "achieved_below_offered"
+
+
+def test_smoke_check_rejects_malformed():
+    good_step = {
+        "offered_qps": 10, "achieved_qps": 10, "sent": 15,
+        "shed_rate": 0.0, "error_rate": 0.0,
+        "classes": {"point": {"p50_ms": 1, "p99_ms": 2, "p999_ms": 3}},
+    }
+    bad = {
+        "metric": "slo_curve", "backend": "cpu", "mix": {},
+        "saturation_knee": None,
+        "offered_sweep": [good_step, {**good_step, "error_rate": 0.5}],
+    }
+    with pytest.raises(AssertionError, match="non-shed errors"):
+        bench_slo.smoke_check(bad)
+    shed_down = {
+        **bad,
+        "offered_sweep": [
+            {**good_step, "shed_rate": 0.4},
+            {**good_step, "shed_rate": 0.1},
+        ],
+    }
+    with pytest.raises(AssertionError, match="monotone"):
+        bench_slo.smoke_check(shed_down)
+
+
+def test_open_loop_step_end_to_end(monkeypatch):
+    """One tiny real step: the schedule fires against a live server,
+    latencies come back per class, nothing errors, and the offered rate
+    is honored to within Poisson noise."""
+    monkeypatch.setenv("DGRAPH_TPU_SCHED", "1")
+    monkeypatch.setenv("DGRAPH_TPU_CACHE", "0")
+    from bench import _serving_store
+    from dgraph_tpu.serve.server import DgraphServer
+
+    srv = DgraphServer(_serving_store(500, 4))
+    srv.start()
+    try:
+        classes = [
+            {
+                "name": "point", "rate": 30.0, "tenant": "",
+                "pool": [
+                    "{ q(func: uid(0x%x)) { c: count(e) } }" % u
+                    for u in range(1, 9)
+                ],
+            },
+        ]
+        bench_slo._warmup(srv.port, classes)
+        step = bench_slo.open_loop_step(
+            srv.port, classes, secs=1.0, seed=3, workers=8
+        )
+        assert step["error_rate"] == 0.0
+        assert step["shed_rate"] == 0.0
+        rec = step["classes"]["point"]
+        assert rec["ok"] == step["sent"] > 10
+        assert rec["p50_ms"] > 0
+        assert rec["p999_ms"] >= rec["p99_ms"] >= rec["p50_ms"]
+        # offered honored: the schedule, not the server, set the pace
+        assert 15 < step["offered_qps"] < 50
+    finally:
+        srv.stop()

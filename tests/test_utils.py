@@ -43,6 +43,97 @@ def test_metrics_prometheus_text():
     assert "# TYPE reads_total counter" in text
 
 
+def test_metrics_new_gauge_kinds():
+    r = MetricsRegistry()
+    r.func_gauge("up_seconds", lambda: 12.5)
+    r.multilabeled_gauge("build_info", ("version", "backend")).set(
+        ("0.1.0", "cpu"), 1
+    )
+    text = r.prometheus_text()
+    assert "up_seconds 12.5" in text
+    assert "# TYPE up_seconds gauge" in text
+    assert 'build_info{version="0.1.0",backend="cpu"} 1' in text
+    assert "# TYPE build_info gauge" in text
+    with pytest.raises(ValueError):
+        r.multilabeled_gauge("build_info", ("version", "backend")).set(
+            ("only-one",), 1
+        )
+
+
+def _valid_openmetrics(body: str) -> None:
+    """Structural validity: # EOF exactly at the end, every non-comment
+    line is `name{labels} value [exemplar]`, and each histogram's
+    cumulative bucket counts are non-decreasing with count == +Inf."""
+    import re
+
+    lines = body.splitlines()
+    assert lines[-1] == "# EOF"
+    assert "# EOF" not in lines[:-1]
+    line_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+(inf)?"
+        r"( # \{[^{}]*\} [0-9.e+-]+ [0-9.]+)?$"
+    )
+    buckets = {}  # (name, labels-sans-le) -> cumulative counts in order
+    for ln in lines[:-1]:
+        if ln.startswith("#"):
+            assert ln.startswith("# TYPE "), ln
+            continue
+        assert line_re.match(ln), ln
+        if "_bucket{" in ln:
+            name, rest = ln.split("{", 1)
+            # first "} " closes the label set; an exemplar's own braces
+            # come later on the line
+            labels, val = rest.split("} ", 1)
+            series = (name, re.sub(r'le="[^"]*",?', "", labels))
+            buckets.setdefault(series, []).append(
+                float(val.split(" # ", 1)[0])
+            )
+    for series, cum in buckets.items():
+        assert all(a <= b for a, b in zip(cum, cum[1:])), (series, cum)
+
+
+def test_exposition_valid_under_mutation_storm():
+    """Satellite acceptance (ISSUE 13): /metrics exposition under an
+    8-thread observe() storm renders structurally valid OpenMetrics on
+    EVERY scrape — no torn lines, no bucket-count regressions, the
+    terminator in place."""
+    r = MetricsRegistry()
+    h = r.histogram("storm_seconds", (0.001, 0.01, 0.1, 1.0))
+    lh = r.labeled_histogram("storm_tenant_seconds", "tenant", (0.01, 1.0))
+    c = r.counter("storm_total")
+    ml = r.multilabeled("storm_rpc_total", ("peer", "outcome"))
+    stop = threading.Event()
+
+    def storm(tid: int):
+        i = 0
+        while not stop.is_set():
+            h.observe((i % 7) / 100.0, trace_id=f"{tid:032x}")
+            lh.observe(f"t{i % 5}", (i % 3) / 10.0)
+            c.add(1)
+            ml.add((f"p{tid}", "ok"))
+            i += 1
+
+    threads = [
+        threading.Thread(target=storm, args=(t,), daemon=True)
+        for t in range(8)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(30):
+            _valid_openmetrics(r.openmetrics_text())
+            # the classic format must stay parseable too
+            classic = r.prometheus_text()
+            assert classic.endswith("\n") and "# EOF" not in classic
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    # post-storm: the terminal scrape agrees with the counters
+    assert c.value() > 0
+    _valid_openmetrics(r.openmetrics_text())
+
+
 def test_latency_map():
     lat = Latency()
     lat.record_parsing()
