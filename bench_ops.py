@@ -182,13 +182,14 @@ def bench_kway_intersection(platform, emit):
     n = int(os.environ.get("BO_KWAY_UNIVERSE", 1200))
     L = int(os.environ.get("BO_KWAY_L", 1024))
 
-    # satellite guard: the k-way folds no longer serialize — neither
-    # lowers to a lax.scan (intersect_many is now a log-depth tree)
-    probe = jnp.asarray(
-        np.stack([ops.pad_to(np.arange(5), 16) for _ in range(8)])
-    )
-    assert "scan[" not in str(jax.make_jaxpr(ops.intersect_many)(probe))
-    assert "scan[" not in str(jax.make_jaxpr(ops.union_many)(probe))
+    # satellite guard: the k-way folds no longer serialize.  The
+    # scan-free property is a registered program contract now —
+    # the bench just invokes the single source of truth instead of
+    # hand-grepping jaxprs (analysis/programs.py, trace-only checks).
+    from dgraph_tpu.analysis import programs
+
+    programs.assert_contract("sets.intersect_many")
+    programs.assert_contract("sets.union_many")
 
     for B in (1, 64, 1024):
         for k in (2, 4, 8):

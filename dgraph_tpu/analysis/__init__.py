@@ -16,10 +16,16 @@ rather than generic style:
 - :mod:`.witness` — runtime lock-order witness recorder (lockdep-style),
   armed during tests by ``tests/conftest.py``;
 - :mod:`.pytest_budget` — pytest hooks enforcing per-test JAX compile
-  budgets (``analysis/budgets.json``) and ``jax.transfer_guard`` markers.
+  budgets (``analysis/budgets.json``) and ``jax.transfer_guard`` markers;
+- :mod:`.programs` — tier 2: the device-program contract checker.  Every
+  compiled-kernel factory registers a contract (scan-freedom, dtype
+  discipline, donation aliasing, transfer-freedom, cost budget,
+  bucket-key soundness) checked on the traced jaxpr/StableHLO against
+  golden fingerprints in ``analysis/programs.json``.
 
 CLI: ``python -m dgraph_tpu.analysis`` (see ``--help``; exits nonzero on
-any non-baselined finding or lock-order cycle).  Docs: docs/analysis.md.
+any non-baselined finding or lock-order cycle) and ``--programs`` /
+``--update-programs`` for tier 2.  Docs: docs/analysis.md.
 """
 
 from dgraph_tpu.analysis.framework import (  # noqa: F401

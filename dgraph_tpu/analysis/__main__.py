@@ -6,6 +6,14 @@ on any non-baselined finding, lock-order cycle, or self-nesting on a
 non-reentrant lock.  CI runs this with the shipped (empty) baseline;
 ``--write-baseline`` exists for adopting the suite on a tree with
 standing debt, not for silencing new findings.
+
+``--programs`` runs graftcheck tier 2 instead: the device-program
+contract checker (analysis/programs.py) traces every registered
+compiled-kernel factory and enforces its declared invariants plus the
+golden jaxpr fingerprints in ``analysis/programs.json``; an intentional
+structural change is re-blessed with ``--update-programs`` (which still
+refuses to bless a program violating its non-golden contract checks).
+CI runs both passes as separate steps.
 """
 
 from __future__ import annotations
@@ -54,7 +62,31 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--no-locks", action="store_true", help="skip the lock-order pass"
     )
+    ap.add_argument(
+        "--programs", action="store_true",
+        help="run the device-program contract checker (tier 2) instead "
+             "of the lint/lock passes",
+    )
+    ap.add_argument(
+        "--update-programs", action="store_true",
+        help="re-bless the golden program fingerprints "
+             "(analysis/programs.json) after the contract checks pass",
+    )
+    ap.add_argument(
+        "--programs-goldens", metavar="PATH", default=None,
+        help="alternate goldens file for --programs (default: "
+             "analysis/programs.json)",
+    )
     ns = ap.parse_args(argv)
+
+    if ns.programs or ns.update_programs:
+        # tier 2 runs alone: it traces/lowers real kernels (imports jax
+        # and the ops modules), a different beast from the AST passes
+        from dgraph_tpu.analysis.programs import run_check
+
+        return run_check(
+            goldens_path=ns.programs_goldens, update=ns.update_programs
+        )
 
     pkg_root = Path(__file__).resolve().parents[1]   # dgraph_tpu/
     repo_root = pkg_root.parent

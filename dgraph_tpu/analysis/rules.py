@@ -1027,6 +1027,127 @@ class UnregisteredMetric(Rule):
                 )
 
 
+# -- rule: unregistered-program-factory --------------------------------------
+
+# the compiled-program constructors: jax.jit / jax.pmap (via the shared
+# alias helper) plus pallas_call in its import spellings
+_PALLAS_NAMES = {
+    "pallas_call", "pl.pallas_call", "pallas.pallas_call",
+    "jax.experimental.pallas.pallas_call",
+}
+
+
+def _factory_names(tree: ast.AST) -> Set[str]:
+    return _jit_aliases(tree) | _PALLAS_NAMES
+
+
+def _is_factory_construction(node: ast.AST, names: Set[str]) -> bool:
+    """A Call that actually BUILDS a compiled-program factory:
+    ``jax.jit(fn)`` / ``pl.pallas_call(kernel, ...)`` /
+    ``partial(jax.jit, static_argnames=...)(fn)`` with operands (a bare
+    ``jax.jit`` reference constructs nothing)."""
+    if not isinstance(node, ast.Call):
+        return False
+    if _dotted(node.func) in names and bool(node.args):
+        return True
+    # the curried spelling: partial(jax.jit, ...)(fn) — the inner
+    # partial(...) Call is not itself a construction (so no double
+    # count), the application to fn is
+    f = node.func
+    return (
+        isinstance(f, ast.Call)
+        and _dotted(f.func) in ("partial", "functools.partial")
+        and bool(f.args)
+        and _is_jit_expr(f.args[0], names)
+        and bool(node.args)
+    )
+
+
+class UnregisteredProgramFactory(Rule):
+    id = "unregistered-program-factory"
+    doc = (
+        "jax.jit / pl.pallas_call construction in dgraph_tpu/ whose "
+        "factory site is not registered in the device-program contract "
+        "registry (analysis/programs.py) — every compiled kernel "
+        "carries a checked contract or an explicit exemption"
+    )
+
+    # tests pin the acceptance set; production reads the live registry
+    coverage_override: Optional[Set[str]] = None
+
+    @classmethod
+    def coverage(cls) -> Set[str]:
+        if cls.coverage_override is not None:
+            return cls.coverage_override
+        # lazy: programs.py imports nothing heavy at module level by
+        # design, so the lint pass stays cheap
+        from dgraph_tpu.analysis.programs import covered_sites
+
+        return covered_sites()
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if not (
+            path.startswith("dgraph_tpu/") or "/dgraph_tpu/" in path
+        ) or "analysis/" in path:
+            return
+        names = _factory_names(ctx.tree)
+        sites: List[Tuple[ast.AST, str]] = []
+        self._visit(ctx.tree, [], names, sites, set())
+        cov = self.coverage()
+        for node, qual in sites:
+            key = f"{path}::{qual}"
+            if key not in cov:
+                yield ctx.finding(
+                    self.id, node,
+                    f"compiled-program factory `{key}` is not registered "
+                    "in the program-contract registry: add a "
+                    "ProgramContract covering it (or an EXEMPT_SITES "
+                    "entry with the why) in dgraph_tpu/analysis/"
+                    "programs.py — kernels land with a contract, not a "
+                    "hope (docs/analysis.md#program-contracts)",
+                )
+
+    def _visit(
+        self, node: ast.AST, stack: List[str], names: Set[str],
+        out: List[Tuple[ast.AST, str]], seen: Set[int],
+    ) -> None:
+        qual = ".".join(stack) if stack else "<module>"
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec, names):
+                    # anchor on the decorator line so the pragma sits
+                    # where the construction is
+                    out.append((dec, ".".join(stack + [node.name])))
+                    seen.update(
+                        id(s) for s in ast.walk(dec)
+                        if isinstance(s, ast.Call)
+                    )
+            stack = stack + [node.name]
+        elif isinstance(node, ast.ClassDef):
+            stack = stack + [node.name]
+        elif isinstance(node, ast.Assign) and _is_factory_construction(
+            node.value, names
+        ):
+            # `intersect_batch = jax.jit(...)` at module level is named
+            # by its target; inside a factory function the function IS
+            # the site name
+            t = node.targets[0]
+            site = (
+                t.id if qual == "<module>" and isinstance(t, ast.Name)
+                else qual
+            )
+            out.append((node, site))
+            seen.add(id(node.value))
+        elif (
+            _is_factory_construction(node, names) and id(node) not in seen
+        ):
+            out.append((node, qual))
+            seen.add(id(node))
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, stack, names, out, seen)
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     HostSyncInJit(),
     RecompileHazard(),
@@ -1039,4 +1160,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     NakedVersionKey(),
     UncheckedHopLoop(),
     UnregisteredMetric(),
+    UnregisteredProgramFactory(),
 )

@@ -228,9 +228,8 @@ def multi_hop(
     cap] — the post-dedup frontier ENTERING hop i+1 —, edge counts
     int32[n_hops], final visited int32[cap]).
     """
-    import warnings
-
     from dgraph_tpu import obs
+    from dgraph_tpu.utils.jaxdiag import expected_unusable_donation
 
     # sampled requests record the whole fused scan as ONE span (it IS
     # one device program): hop count + capacity say what the chain/
@@ -239,13 +238,11 @@ def multi_hop(
     # fully async.
     sp = obs.current_span()
     ms = obs.NOOP if sp is None else sp.child("multi_hop")
-    with warnings.catch_warnings(), ms:
-        # backends that cannot alias a given carry (e.g. the untouched
-        # visited buffer when track_visited=False, or XLA-CPU outputs)
-        # warn per compiled shape; donation is best-effort by design
-        warnings.filterwarnings(
-            "ignore", message="Some donated buffers were not usable"
-        )
+    # one [cap]-shaped output means only ONE of the two donated carries
+    # can alias; the visited buffer's fallback is contract-checked
+    # (analysis/programs.py batch.multi_hop, donate_unused_ok) and
+    # counted (dgraph_donation_fallback_total) instead of blanket-hidden
+    with expected_unusable_donation("ops.batch.multi_hop"), ms:
         res = _multi_hop_jit(
             offsets, dst, frontier, visited, n_hops, cap, track_visited, lut
         )

@@ -14,6 +14,10 @@ float32 rounding on the vals tensor can never swap two close keys.  Ties
 the host path's stable ``sorted``.  Missing values (uid has no value for
 the predicate) sort last ascending and first descending, exactly like the
 host key ``(9,)`` under ``reverse=``.
+
+Both kernels ride the ``order.segmented_sort`` device-program contract
+(analysis/programs.py): int32 discipline and the sort permutation's
+scan-freedom are fingerprint-pinned by the --programs CI gate.
 """
 
 from __future__ import annotations

@@ -23,7 +23,11 @@ lowering is unverified (the round-4 tunnel outage blocked real-chip
 compilation — interpret mode skips Mosaic, and the 1-D scratch reshape /
 dynamic slices here are constructs it may want reshaped), so integration
 is a measure-first task for the next chip session: compile, A/B against
-the XLA slot-map, then gate into expand_inline_grouped.
+the XLA slot-map, then gate into expand_inline_grouped.  The kernel is
+registered EXPERIMENTAL in the device-program contract registry
+(analysis/programs.py "pallas.slotmap"): callback/dtype invariants and
+a golden fingerprint are enforced now, and promotion to a full contract
+(transfer/cost checks, a bucket probe) is part of that chip session.
 """
 
 from __future__ import annotations

@@ -24,6 +24,13 @@ uids are dense int32 "local ids" assigned at ingest by the uid dictionary
 (models/uids.py), not the reference's sparse uint64 space: 64-bit ints are
 emulated (slow) on TPU, and dense ids double as direct indexes into value
 arenas.
+
+Every jit factory here is registered in the device-program contract
+registry (dgraph_tpu/analysis/programs.py): scan-freedom, the int32
+dtype discipline, transfer-freedom and the pow2 bucket-key soundness of
+expand_csr are checked against golden jaxpr fingerprints by ``python -m
+dgraph_tpu.analysis --programs`` — a structural change here must be
+re-blessed there (docs/analysis.md "Program contracts").
 """
 
 from __future__ import annotations

@@ -49,6 +49,10 @@ programs (tests/test_spgemm.py pins this).
 
 Route choice between this tier and pairwise expansion lives in
 query/joinplan.py; docs/deploy.md ("Join tier") covers the knobs.
+Every kernel here carries a device-program contract
+(analysis/programs.py: the f32 tile discipline, callback/transfer
+freedom, mask_lanes bucket soundness, golden fingerprints) — re-bless
+with --update-programs after an intentional structural change.
 """
 
 from __future__ import annotations

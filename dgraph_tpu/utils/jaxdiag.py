@@ -1,0 +1,58 @@
+"""Scoped, counted handling of *expected* JAX compiler diagnostics.
+
+The one current citizen is the donation fallback: ``jax.jit`` warns
+"Some donated buffers were not usable" when a donated argument cannot
+alias any output.  For ``ops.batch.multi_hop`` that is by design — the
+program exposes exactly one ``[cap]``-shaped output, so only one of the
+two donated carries can alias (the ``batch.multi_hop`` contract in
+``analysis/programs.py`` checks precisely this: frontier MUST alias,
+visited is declared ``donate_unused_ok``).  The old code blanket-ignored
+the warning with ``warnings.filterwarnings("ignore", message=...)``,
+which hid every OTHER donation regression at the site too and left no
+trace that the fallback fired at all.
+
+:func:`expected_unusable_donation` replaces that: the known warning is
+swallowed but **counted** (``dgraph_donation_fallback_total{site}`` —
+a sudden rate change on a backend that used to alias is an alert, not
+silence), every other warning raised inside the block is re-emitted
+untouched, and the structural half of the invariant — donation still
+*declared* and aliased where usable — is enforced by the program
+contract checker (``python -m dgraph_tpu.analysis --programs``), so
+the suppression can never quietly outlive the property it assumes.
+
+Like ``warnings.catch_warnings`` itself this is not thread-isolated
+(the warnings filter is process-global); the wrapped region only
+compiles/dispatches, same as the code it replaced.
+"""
+
+from __future__ import annotations
+
+import re
+import warnings
+from contextlib import contextmanager
+
+from dgraph_tpu.utils.metrics import DONATION_FALLBACK
+
+_UNUSABLE_DONATION = re.compile(r"donated buffers were not usable")
+
+
+@contextmanager
+def expected_unusable_donation(site: str):
+    """Swallow-and-count JAX's unusable-donation warning for a site
+    whose unaliased carry is contract-checked; re-emit everything else.
+    """
+    rec = []
+    try:
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            yield
+    finally:
+        # drain even when the wrapped block raises: a failed compile
+        # must not eat the diagnostics emitted before the failure
+        for w in rec:
+            if _UNUSABLE_DONATION.search(str(w.message)):
+                DONATION_FALLBACK.add(site)
+            else:
+                warnings.warn_explicit(
+                    w.message, w.category, w.filename, w.lineno
+                )

@@ -570,6 +570,15 @@ SWALLOWED_EXC = metrics.labeled(
     "dgraph_swallowed_exceptions_total", label="site"
 )
 
+# expected-donation fallbacks (utils/jaxdiag.py): JAX's "donated buffers
+# were not usable" warning, swallowed ONLY at contract-checked sites
+# (analysis/programs.py declares which carry may go unaliased) and
+# counted here instead of vanishing — on a backend that used to alias,
+# a nonzero rate is a donation regression to chase, not noise.
+DONATION_FALLBACK = metrics.labeled(
+    "dgraph_donation_fallback_total", label="site"
+)
+
 
 # resilience layer (cluster/peerclient.py, utils/failpoints.py): every
 # peer RPC lands in PEER_RPC as {peer, op, outcome} — outcome "ok",

@@ -53,6 +53,22 @@ from dgraph_tpu.analysis.pytest_budget import (  # noqa: E402,F401
 
 _WITNESS_ON = os.environ.get("DGRAPH_TPU_WITNESS", "1") != "0"
 
+# 3. program-contract goldens guard (graftcheck tier 2): the golden
+#    fingerprints in analysis/programs.json are re-blessed ONLY by an
+#    explicit `--update-programs` run — a test that writes them through
+#    the default path would silently rewrite the contract for every
+#    future run.  Hash at configure, verify at session end.
+import hashlib  # noqa: E402
+from pathlib import Path  # noqa: E402
+
+_GOLDENS = Path(__file__).resolve().parents[1] / (
+    "dgraph_tpu/analysis/programs.json"
+)
+_GOLDENS_HASH0 = (
+    hashlib.sha1(_GOLDENS.read_bytes()).hexdigest()
+    if _GOLDENS.exists() else None
+)
+
 
 def pytest_configure(config):
     budget_plugin_configure(config)
@@ -90,3 +106,23 @@ def pytest_sessionfinish(session, exitstatus):
         # an inversion is a deadlock waiting for the right interleaving:
         # fail the run even when every individual test passed
         session.exitstatus = 1
+    now = (
+        hashlib.sha1(_GOLDENS.read_bytes()).hexdigest()
+        if _GOLDENS.exists() else None
+    )
+    if now != _GOLDENS_HASH0:
+        # diagnose UNCONDITIONALLY: on an otherwise-failing run the
+        # mutation would persist on disk, seed the next session's
+        # baseline hash, and escape detection forever
+        import sys
+
+        print(
+            "\nPROGRAM GOLDENS MUTATED DURING THE RUN: a test rewrote "
+            "dgraph_tpu/analysis/programs.json — goldens change only "
+            "via an explicit `python -m dgraph_tpu.analysis "
+            "--update-programs`; point test blessings at tmp_path and "
+            "`git checkout` the file before the next run.",
+            file=sys.stderr,
+        )
+        if session.exitstatus == 0:
+            session.exitstatus = 1

@@ -1232,3 +1232,124 @@ def test_transfer_guard_marker_is_load_bearing():
     with jax.transfer_guard("disallow"):
         with pytest.raises(Exception, match="[Dd]isallowed"):
             bool(x[0] > 1)
+
+
+# ------------------------------------- rule: unregistered-program-factory
+
+def test_unregistered_program_factory_flagged():
+    """Golden-bad: every jit/pallas_call construction spelling in
+    dgraph_tpu/ must be flagged when its site is not in the registry —
+    decorator, partial-decorator, module-level assign, factory-return,
+    method, and pallas_call."""
+    from dgraph_tpu.analysis.rules import UnregisteredProgramFactory
+
+    UnregisteredProgramFactory.coverage_override = set()
+    try:
+        bad = textwrap.dedent("""
+            from functools import partial
+            import jax
+            from jax.experimental import pallas as pl
+
+            @jax.jit
+            def plain(x):
+                return x + 1
+
+            @partial(jax.jit, static_argnames=("cap",))
+            def with_static(x, cap):
+                return x[:cap]
+
+            batched = jax.jit(jax.vmap(lambda a: a * 2))
+
+            def factory(n):
+                def fn(x):
+                    return x * n
+                return jax.jit(fn)
+
+            class Expander:
+                def _build(self):
+                    return jax.jit(lambda m: m)
+
+            def kernel_entry(x):
+                return pl.pallas_call(_kernel, grid=(1,))(x)
+
+            curried = partial(jax.jit, static_argnames=("desc",))(plain)
+        """)
+        found = check_source(
+            bad, [UnregisteredProgramFactory()],
+            path="dgraph_tpu/ops/fake.py",
+        )
+        assert _ids(found) == ["unregistered-program-factory"] * 7
+        sites = {f.message.split("`")[1] for f in found}
+        assert sites == {
+            "dgraph_tpu/ops/fake.py::plain",
+            "dgraph_tpu/ops/fake.py::with_static",
+            "dgraph_tpu/ops/fake.py::batched",
+            "dgraph_tpu/ops/fake.py::factory",
+            "dgraph_tpu/ops/fake.py::Expander._build",
+            "dgraph_tpu/ops/fake.py::kernel_entry",
+            "dgraph_tpu/ops/fake.py::curried",
+        }
+    finally:
+        UnregisteredProgramFactory.coverage_override = None
+
+
+def test_unregistered_program_factory_counterexamples_clean():
+    """Registered sites, non-package paths, and non-constructions (a
+    bare jax.jit reference, jnp math) are all clean; pragma works."""
+    from dgraph_tpu.analysis.rules import UnregisteredProgramFactory
+
+    src = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def registered(x):
+            return x + 1
+
+        HANDLE = jax.jit          # a reference, not a construction
+        y = jax.vmap(lambda a: a) # vmap alone compiles nothing
+    """)
+    UnregisteredProgramFactory.coverage_override = {
+        "dgraph_tpu/ops/fake.py::registered"
+    }
+    try:
+        assert check_source(
+            src, [UnregisteredProgramFactory()],
+            path="dgraph_tpu/ops/fake.py",
+        ) == []
+        # outside the package: the rule is scoped to dgraph_tpu/
+        UnregisteredProgramFactory.coverage_override = set()
+        assert check_source(
+            src, [UnregisteredProgramFactory()], path="scripts/tool.py"
+        ) == []
+        pragmad = textwrap.dedent("""
+            import jax
+
+            # graftlint: ignore[unregistered-program-factory]
+            @jax.jit
+            def oneoff(x):
+                return x
+        """)
+        assert check_source(
+            pragmad, [UnregisteredProgramFactory()],
+            path="dgraph_tpu/ops/fake.py",
+        ) == []
+    finally:
+        UnregisteredProgramFactory.coverage_override = None
+
+
+def test_program_factory_live_coverage_names_real_sites():
+    """The production acceptance set comes from the live registry and
+    must contain the load-bearing kernels and the documented
+    exemptions (a rename on either side surfaces here, not in CI)."""
+    from dgraph_tpu.analysis.rules import UnregisteredProgramFactory
+
+    cov = UnregisteredProgramFactory.coverage()
+    for key in (
+        "dgraph_tpu/ops/sets.py::intersect_many",
+        "dgraph_tpu/ops/batch.py::_multi_hop_jit",
+        "dgraph_tpu/ops/spgemm.py::run_mask_chain",
+        "dgraph_tpu/ops/pallas_slotmap.py::slotmap_pallas",
+        "dgraph_tpu/query/chain.py::_run_fused",
+        "dgraph_tpu/utils/calibrate.py::measure.gather",
+    ):
+        assert key in cov, key

@@ -176,12 +176,13 @@ def test_intersect_many_tree_matches_reference_odd_widths():
 def test_kway_folds_are_scan_free():
     """The satellite contract: neither k-way fold lowers to a serial
     lax.scan (the tree reduction replaced intersect_many's fold;
-    union_many is one flat bitonic sort)."""
-    mat = jnp.asarray(
-        np.stack([ops.pad_to(np.arange(5), 16) for _ in range(6)])
-    )
-    assert "scan[" not in str(jax.make_jaxpr(ops.intersect_many)(mat))
-    assert "scan[" not in str(jax.make_jaxpr(ops.union_many)(mat))
+    union_many is one flat bitonic sort).  Since PR 14 the property
+    lives in the program-contract registry — this test (and the bench's
+    twin guard) just invokes the single source of truth."""
+    from dgraph_tpu.analysis import programs
+
+    programs.assert_contract("sets.intersect_many")
+    programs.assert_contract("sets.union_many")
 
 
 def test_intersect_masks_stacked_product():
