@@ -340,13 +340,15 @@ class _ServerArm:
                 os.environ[k] = v
 
 
-def _warmup(port: int, classes: list) -> None:
+def _warmup(port: int, classes: list, n: int = 8) -> None:
     """Untimed compile/cache warmup: one pass over every pool so the
-    first measured step never pays XLA compilation."""
+    first measured step never pays XLA compilation.  ``n`` widens the
+    pass for arms whose assertions cannot tolerate a single mid-step
+    compile (the devfault watchdog)."""
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
     try:
         for c in classes:
-            for body in c["pool"][:8]:
+            for body in c["pool"][:n]:
                 conn.request("POST", "/query", body=body.encode())
                 conn.getresponse().read()
     finally:
@@ -539,6 +541,114 @@ def run_ivm_arm(store, secs, workers, seed) -> dict:
     return {"read_offered_qps": read_rate, "steps": steps}
 
 
+def run_devfault_arm(store, rates, secs, workers, seed) -> dict:
+    """p999 vs offered load with a MID-SWEEP wedged-dispatch injection,
+    devguard on vs off — the PR-15 device-fault A/B.  The bench shares
+    the server's process, so the failpoint arms in-process: halfway
+    through the middle step, ``device.hop`` starts hanging for
+    ``SLO_DEVFAULT_WEDGE_MS`` (default 1500) up to ``SLO_DEVFAULT_HANGS``
+    times.  With the guard on the watchdog (``SLO_DEVFAULT_HANG_MS``,
+    default 100) bounds each wedge and hot-fails the hop to host —
+    byte-identical answers, p999 stays near the deadline; with the
+    guard off every wedge rides the serving path in full."""
+    from dgraph_tpu.utils import devguard
+    from dgraph_tpu.utils.failpoints import fail
+    from dgraph_tpu.utils.metrics import DEVICE_FAILOVER
+
+    wedge_ms = _env_f("SLO_DEVFAULT_WEDGE_MS", 1500.0)
+    hangs = int(_env_f("SLO_DEVFAULT_HANGS", 2))
+    rng = np.random.default_rng(seed + 5000)
+    n_nodes = int(_env_f("SLO_NODES", 20_000))
+    pool = []
+    for _ in range(64):
+        seeds = np.unique(rng.integers(1, n_nodes + 1, size=16))
+        ul = ", ".join("0x%x" % u for u in seeds)
+        pool.append("{ q(func: uid(%s)) { e { e { c: count(e) } } } }" % ul)
+    inject_step = len(rates) // 2
+    out = {"wedge_ms": wedge_ms, "hangs": hangs}
+    fp_seed = int(os.environ.get("DGRAPH_TPU_FAILPOINT_SEED", "0"))
+    for mode, guard in (("devguard_on", "1"), ("devguard_off", "0")):
+        fail.reset(fp_seed)
+        steps = []
+        with _ServerArm(store, {
+            "DGRAPH_TPU_SCHED": "1",
+            # cached hops dodge the dispatch seam entirely — the arm
+            # must measure the seam, not the cache
+            "DGRAPH_TPU_CACHE": "0",
+            "DGRAPH_TPU_DEVGUARD": guard,
+            "DGRAPH_TPU_DEVICE_COOLDOWN_S": "0.2",
+            # pin every hop onto the device dispatch seam (env override
+            # = static gate; the planner yields the decision)
+            "DGRAPH_TPU_EXPAND_DEVICE_MIN": "1",
+        }) as srv:
+            # guards read their env at construction: fresh ones per arm
+            devguard.reset_for_tests()
+            classes = [
+                {"name": "khop", "rate": 0.0, "pool": pool, "tenant": ""}
+            ]
+            # warm under the DEFAULT (compile-tolerant) deadline, then
+            # tighten the live watchdog: a cold XLA compile is slow,
+            # not wedged — tightening first would latch the guard sick
+            # on warmup compiles and pollute the non-injected steps
+            _warmup(srv.port, classes, n=len(pool))
+            devguard.get().hang_ms = _env_f("SLO_DEVFAULT_HANG_MS", 100.0)
+            for step_i, rate in enumerate(rates):
+                classes[0]["rate"] = rate
+                injected = step_i == inject_step
+                timer = None
+                if injected:
+                    timer = threading.Timer(
+                        secs / 2.0,
+                        lambda: fail.arm(
+                            "device.hop",
+                            f"hang(ms={wedge_ms:g},n={hangs})",
+                        ),
+                    )
+                    timer.start()
+                fo0 = sum(DEVICE_FAILOVER.snapshot().values())
+                try:
+                    step = open_loop_step(
+                        srv.port, classes, secs, seed + 6000 + step_i,
+                        workers,
+                    )
+                finally:
+                    if timer is not None:
+                        timer.cancel()
+                k = step["classes"]["khop"]
+                steps.append({
+                    "offered_qps": step["offered_qps"],
+                    "achieved_qps": step["achieved_qps"],
+                    "p50_ms": k["p50_ms"],
+                    "p99_ms": k["p99_ms"],
+                    "p999_ms": k["p999_ms"],
+                    "shed_rate": step["shed_rate"],
+                    "error_rate": step["error_rate"],
+                    "injected": injected,
+                    "failovers": (
+                        sum(DEVICE_FAILOVER.snapshot().values()) - fo0
+                    ),
+                    "device_state": devguard.get().state,
+                })
+                print(
+                    f"# slo devfault[{mode}] offered={rate} "
+                    f"p999={k['p999_ms']}ms"
+                    + (" (wedge injected)" if injected else ""),
+                    file=sys.stderr,
+                )
+            # the n-cap is spent by sweep end: the half-open probe must
+            # re-admit the device (guard-off has no state to heal)
+            healed = guard == "0"
+            deadline = time.monotonic() + 15.0
+            while not healed and time.monotonic() < deadline:
+                healed = devguard.get().state == "healthy"
+                if not healed:
+                    time.sleep(0.1)
+        fail.reset(fp_seed)
+        out[mode] = {"steps": steps, "readmitted": healed}
+    devguard.reset_for_tests()
+    return out
+
+
 # ------------------------------------------------------------------ main
 
 def run_slo_bench() -> dict:
@@ -574,6 +684,15 @@ def run_slo_bench() -> dict:
             ivm = run_ivm_arm(store, secs, workers, seed)
         except Exception as e:
             ivm = {"error": f"{type(e).__name__}: {e}"}
+    devfault = None
+    if os.environ.get("SLO_DEVFAULT", "1") != "0":
+        try:
+            devfault = run_devfault_arm(
+                store, _env_rates("SLO_DEVFAULT_RATES", "20,40"), secs,
+                workers, seed,
+            )
+        except Exception as e:
+            devfault = {"error": f"{type(e).__name__}: {e}"}
 
     from dgraph_tpu.obs import ledger as _ledgermod
 
@@ -589,6 +708,7 @@ def run_slo_bench() -> dict:
         "saturation_knee": sweep["saturation_knee"],
         "qos": qos,
         "ivm": ivm,
+        "devfault": devfault,
         # the serving-path cost account for the whole run (obs/ledger.py):
         # edges/sec across the sweep is achieved_qps × edges-per-query,
         # and this is the series it reconciles against
@@ -620,6 +740,30 @@ def smoke_check(out: dict) -> None:
         assert b >= a - 0.02, (
             f"slo smoke: shed rate not monotone across offered load "
             f"({sheds})"
+        )
+    dv = out.get("devfault")
+    if dv and "error" not in dv:
+        on, off = dv["devguard_on"], dv["devguard_off"]
+        assert on["readmitted"], (
+            "devfault smoke: device not re-admitted after the wedge healed"
+        )
+        inj_on = next(s for s in on["steps"] if s["injected"])
+        inj_off = next(s for s in off["steps"] if s["injected"])
+        assert inj_on["failovers"] > 0, (
+            "devfault smoke: the wedge never drove a host failover"
+        )
+        for s in on["steps"]:
+            assert s["error_rate"] == 0.0, (
+                "devfault smoke: guard-on arm surfaced errors"
+            )
+        # structural separation: the watchdog bounds the wedge (guard
+        # on), the legacy path eats it in full (guard off)
+        assert inj_on["p999_ms"] < dv["wedge_ms"], (
+            f"devfault smoke: guard did not bound the wedge "
+            f"(p999 {inj_on['p999_ms']}ms vs wedge {dv['wedge_ms']}ms)"
+        )
+        assert inj_off["p999_ms"] >= dv["wedge_ms"] * 0.6, (
+            "devfault smoke: guard-off arm never observed the wedge"
         )
 
 

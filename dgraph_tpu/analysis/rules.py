@@ -48,6 +48,16 @@ on a noisy 2-core CPU host:
   3-hop queries it wins (BENCH21M).  Every gate lives in
   ``utils/planconfig.py`` with a documented default, and the decision
   itself belongs to the calibrated planner (``query/planner.py``).
+- ``naked-device-sync``: a bare ``.block_until_ready()`` /
+  ``jax.block_until_ready`` / ``jax.device_get`` / no-arg ``.item()``
+  sync point on the HOST orchestration path in ``query/``, ``ops/``,
+  ``parallel/`` or ``sched/`` — a naked sync is exactly where a wedged
+  chip blocks a flush worker forever (TPU bench rounds 4-5 ran on one).
+  Device syncs in the serving tree go through the device guard's
+  watchdog bracket (``utils/devguard.py`` — deadline + SICK latch +
+  host failover) or ``obs.block_ready_ms`` (which also attributes the
+  wait to the span); a deliberate host-value ``.item()`` carries the
+  pragma with the WHY.
 - ``unchecked-hop-loop``: a loop in ``query/`` that drives the
   expander/dispatch seam (``expand``/``submit_hop``/``_expand_rows``/
   ``_exec_child``/``multi_hop``) without a ``CancelToken`` checkpoint —
@@ -859,6 +869,64 @@ class NakedVersionKey(Rule):
                     )
 
 
+# -- rule: naked-device-sync --------------------------------------------------
+
+class NakedDeviceSync(Rule):
+    id = "naked-device-sync"
+    doc = (
+        "bare .block_until_ready()/jax.block_until_ready/jax.device_get/"
+        ".item() sync point in query/, ops/, parallel/ or sched/ — device "
+        "syncs in the serving tree go through the device guard "
+        "(utils/devguard.py watchdog bracket) or obs.block_ready_ms, so a "
+        "wedged chip can never block a flush worker forever"
+    )
+
+    # the serving layers whose host orchestration dispatches device
+    # programs; utils/devguard.py (the watchdog's home) and obs/ (the
+    # block_ready_ms wrapper) sit outside them by design.  In-jit sync
+    # points are host-sync-in-jit's jurisdiction — this rule covers the
+    # HOST side of the seam, so it skips traced bodies to keep one
+    # finding per bug class.
+    _DIRS = ("query/", "ops/", "parallel/", "sched/")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if not any(d in path for d in self._DIRS):
+            return
+        jit_names = _jit_aliases(ctx.tree)
+        traced_lines: Set[int] = set()
+        for fn, _static, _why in _traced_functions(ctx.tree, jit_names):
+            end = getattr(fn, "end_lineno", fn.lineno)
+            traced_lines.update(range(fn.lineno, end + 1))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if node.lineno in traced_lines:
+                continue  # host-sync-in-jit owns the traced bodies
+            f = node.func
+            hit = None
+            if isinstance(f, ast.Attribute):
+                if f.attr == "block_until_ready" or (
+                    f.attr == "item" and not node.args
+                ):
+                    hit = f.attr
+            d = _dotted(f)
+            if d in ("jax.block_until_ready", "jax.device_get", "device_get"):
+                hit = d
+            if hit is None:
+                continue
+            yield ctx.finding(
+                self.id, node,
+                f"naked `{hit}` sync point on the host orchestration "
+                "path: a wedged dispatch blocks this worker with no "
+                "deadline and no failover — bracket the dispatch+fetch "
+                "with the device guard (utils/devguard.py run()) or use "
+                "obs.block_ready_ms so the wait is watchdogged and "
+                "span-attributed, or pragma a deliberate host-value "
+                ".item() with the WHY",
+            )
+
+
 # -- rule: unchecked-hop-loop -----------------------------------------------
 
 # the expander/dispatch seam: calls that (directly or one wrapper deep)
@@ -1158,6 +1226,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     NakedStageTiming(),
     NakedRouteThreshold(),
     NakedVersionKey(),
+    NakedDeviceSync(),
     UncheckedHopLoop(),
     UnregisteredMetric(),
     UnregisteredProgramFactory(),

@@ -64,6 +64,7 @@ from dgraph_tpu import obs
 from dgraph_tpu.cluster.transport import PeerAuth, urlopen_peer
 from dgraph_tpu.utils.env import env_float as _env_f
 from dgraph_tpu.utils.failpoints import fail
+from dgraph_tpu.utils.health import HalfOpenGate
 from dgraph_tpu.utils.metrics import (
     BREAKER_STATE,
     BREAKER_TRANSITIONS,
@@ -118,19 +119,24 @@ class StaleUnavailableError(OSError):
 
 class _PeerState:
     __slots__ = (
-        "failures", "state", "opened_at", "probe_inflight", "probe_token",
+        "failures", "state", "gate",
         "last_success", "last_failure", "total_failures",
     )
 
     def __init__(self):
         self.failures = 0           # consecutive transport failures
         self.state = CLOSED
-        self.opened_at = 0.0
-        self.probe_inflight = False
-        self.probe_token = 0        # ownership of the half-open probe slot
+        # cooldown + half-open probe-slot discipline: the shared helper
+        # (utils/health.py HalfOpenGate — StorageHealth and the device
+        # guard ride the same one), mutated under PeerClient._lock
+        self.gate = HalfOpenGate()
         self.last_success = 0.0     # monotonic; 0 = never
         self.last_failure = 0.0
         self.total_failures = 0
+
+    @property
+    def opened_at(self) -> float:
+        return self.gate.opened_at
 
 
 class PeerClient:
@@ -204,20 +210,12 @@ class PeerClient:
             st = self._state(peer, op)
             if st.state == CLOSED:
                 return True, 0.0, None
-            if st.state == OPEN:
-                waited = now - st.opened_at
-                if waited >= self.breaker_cooldown:
-                    self._set_state(peer, op, st, HALF_OPEN)
-                    st.probe_inflight = True
-                    st.probe_token += 1
-                    return True, 0.0, st.probe_token
-                return False, self.breaker_cooldown - waited, None
-            # HALF_OPEN: one probe in flight; everyone else sheds
-            if not st.probe_inflight:
-                st.probe_inflight = True
-                st.probe_token += 1
-                return True, 0.0, st.probe_token
-            return False, self.breaker_cooldown, None
+            granted, retry_after, token = st.gate.admit(
+                now, self.breaker_cooldown, half_open=st.state == HALF_OPEN
+            )
+            if granted and st.state == OPEN:
+                self._set_state(peer, op, st, HALF_OPEN)
+            return granted, retry_after, token
 
     def _release_probe(self, peer: str, op: str, token: int) -> None:
         """Free the half-open probe slot WITHOUT judging the peer — runs
@@ -226,8 +224,8 @@ class PeerClient:
         re-granted to a newer probe) is a no-op."""
         with self._lock:
             st = self._peers.get((peer, op))
-            if st is not None and st.probe_token == token:
-                st.probe_inflight = False
+            if st is not None:
+                st.gate.release(token)
 
     def _record(self, peer: str, op: str, ok: bool) -> None:
         now = time.monotonic()
@@ -242,7 +240,7 @@ class PeerClient:
                 st.total_failures += 1
                 st.last_failure = now
                 if st.state == HALF_OPEN or st.failures >= self.breaker_threshold:
-                    st.opened_at = now
+                    st.gate.open(now)
                     self._set_state(peer, op, st, OPEN)
 
     def state_of(self, peer: str, op: Optional[str] = None) -> str:

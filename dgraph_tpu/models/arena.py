@@ -893,25 +893,53 @@ class ArenaManager:
         if not self.budget_bytes:
             return
         while self._lru_total > self.budget_bytes and len(self._lru) > 1:
-            victim, vbytes = next(iter(self._lru.items()))
-            if victim == protect:
+            if not self._pop_lru_victim(protect):
                 break
-            self._lru.pop(victim)
-            self._lru_total -= vbytes
-            cache = self._caches_by_id.get(victim[0])
-            gone = cache.pop(victim[1], None) if cache is not None else None
-            if gone is not None and self.hop_cache is not None:
-                # tier-1 entries are keyed by id(arena): drop them NOW,
-                # while the object is still alive, or a later allocation
-                # recycling the id could alias a dead entry's key
-                self.hop_cache.drop_arena(id(gone))
-            if cache is self._data or cache is self._reverse:
-                skey = (victim[1], cache is self._reverse)
-                if skey in self._sharded:
-                    self._sharded.pop(skey, None)
-                    self._lru_drop(self._sharded, skey)
-            self.evictions += 1
-            ARENA_EVICTIONS.add(1)
+
+    def _pop_lru_victim(self, protect: Optional[tuple] = None) -> bool:
+        """Evict the least-recently-used entry (never ``protect``);
+        returns whether one was dropped.  Caller holds _cache_lock."""
+        if not self._lru:
+            return False
+        victim, vbytes = next(iter(self._lru.items()))
+        if victim == protect:
+            return False
+        self._lru.pop(victim)
+        self._lru_total -= vbytes
+        cache = self._caches_by_id.get(victim[0])
+        gone = cache.pop(victim[1], None) if cache is not None else None
+        if gone is not None and self.hop_cache is not None:
+            # tier-1 entries are keyed by id(arena): drop them NOW,
+            # while the object is still alive, or a later allocation
+            # recycling the id could alias a dead entry's key
+            self.hop_cache.drop_arena(id(gone))
+        if cache is self._data or cache is self._reverse:
+            skey = (victim[1], cache is self._reverse)
+            if skey in self._sharded:
+                self._sharded.pop(skey, None)
+                self._lru_drop(self._sharded, skey)
+        self.evictions += 1
+        ARENA_EVICTIONS.add(1)
+        return True
+
+    def evict_for_oom(self, n: int = 2) -> int:
+        """HBM-pressure valve (utils/devguard.py): a device dispatch
+        just failed RESOURCE_EXHAUSTED, so drop up to ``n`` LRU entries
+        REGARDLESS of the configured budget (the budget is an estimate;
+        the allocator's verdict is ground truth) to give the one retry
+        headroom.  Returns how many entries were dropped — zero means
+        there is nothing left to free and the caller should fall
+        straight to the host route.  In-flight expansions holding a
+        dropped arena keep using their reference safely, exactly like
+        budget eviction; the device copy is freed when the last
+        reference dies."""
+        with self._cache_lock:
+            dropped = 0
+            while dropped < n and len(self._lru) > 1:
+                if not self._pop_lru_victim():
+                    break
+                dropped += 1
+            return dropped
 
     def residency(self) -> dict:
         """HBM-residency + program-cache snapshot (obs/device.py's data

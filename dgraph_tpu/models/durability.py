@@ -30,6 +30,7 @@ import threading
 from typing import Callable, Optional
 
 from dgraph_tpu.utils.env import env_float, env_int
+from dgraph_tpu.utils.health import CooldownProbeLoop
 from dgraph_tpu.utils.metrics import (
     SNAPSHOT_AGE,
     STORAGE_ERRORS,
@@ -94,7 +95,18 @@ class StorageHealth:
         self._lock = threading.Lock()
         self._readonly = False
         self._stopped = False
-        self._probe_thread: Optional[threading.Thread] = None
+        # cooldown-FIRST re-arm loop: the shared discipline
+        # (utils/health.py CooldownProbeLoop — the peer breaker and the
+        # device guard probe the same way): the fault just happened,
+        # and re-proving the disk in the same microsecond mostly proves
+        # nothing (a failpoint-injected or transient fault would re-arm
+        # instantly and flap) — give the condition one interval to clear
+        self._probe_loop = CooldownProbeLoop(
+            self.probe_now,
+            self.probe_interval_s,
+            self._probing_active,
+            name="dgraph-storage-probe",
+        )
         self.errors = 0
         self.rearms = 0
         self.last_error = ""
@@ -107,7 +119,6 @@ class StorageHealth:
         """Record a storage fault and latch read-only mode; idempotent
         under a storm of concurrent faults (one probe thread only)."""
         STORAGE_ERRORS.add(site)
-        start_probe = False
         with self._lock:
             self.errors += 1
             self.last_error = f"{type(exc).__name__}: {exc}"
@@ -122,19 +133,11 @@ class StorageHealth:
                     f"{self.probe_interval_s:g}s)",
                     file=sys.stderr,
                 )
-            if (
-                not self._stopped
-                and (self._probe_thread is None
-                     or not self._probe_thread.is_alive())
-            ):
-                self._probe_thread = threading.Thread(
-                    target=self._probe_loop,
-                    name="dgraph-storage-probe",
-                    daemon=True,
-                )
-                start_probe = True
-        if start_probe:
-            self._probe_thread.start()
+            stopped = self._stopped
+        if not stopped:
+            # idempotent under a storm of concurrent faults — the loop
+            # spawns at most one prober thread
+            self._probe_loop.start()
 
     def note_ok(self) -> None:
         with self._lock:
@@ -156,23 +159,9 @@ class StorageHealth:
         self.note_ok()
         return True
 
-    def _probe_loop(self) -> None:
-        # cooldown FIRST (half-open semantics): the fault just happened,
-        # and re-proving the disk in the same microsecond mostly proves
-        # nothing (a failpoint-injected or transient fault would re-arm
-        # instantly and flap) — give the condition one interval to clear
-        import time
-
-        while True:
-            with self._lock:
-                if self._stopped or not self._readonly:
-                    return
-            time.sleep(self.probe_interval_s)
-            with self._lock:
-                if self._stopped or not self._readonly:
-                    return
-            if self.probe_now():
-                return
+    def _probing_active(self) -> bool:
+        with self._lock:
+            return not self._stopped and self._readonly
 
     def stop(self) -> None:
         with self._lock:

@@ -95,6 +95,8 @@ def snapshot(server=None) -> dict:
     None degrades to the process-wide (backend + compile) view."""
     import jax
 
+    from dgraph_tpu.utils import devguard
+
     out: dict = {
         "backend": jax.default_backend(),
         "devices": len(jax.devices()),
@@ -102,6 +104,12 @@ def snapshot(server=None) -> dict:
         "compiles": {
             "total": XLA_COMPILES.value(),
             "seconds_sum": round(XLA_COMPILE_SECONDS.snapshot()[1], 3),
+        },
+        # device fault domain (utils/devguard.py): state machine +
+        # fault/failover/probe counters per domain
+        "guard": {
+            "enabled": devguard.enabled(),
+            "domains": devguard.summary(),
         },
     }
     if server is None:

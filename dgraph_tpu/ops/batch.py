@@ -229,6 +229,8 @@ def multi_hop(
     int32[n_hops], final visited int32[cap]).
     """
     from dgraph_tpu import obs
+    from dgraph_tpu.utils import devguard
+    from dgraph_tpu.utils.failpoints import fail
     from dgraph_tpu.utils.jaxdiag import expected_unusable_donation
 
     # sampled requests record the whole fused scan as ONE span (it IS
@@ -238,20 +240,37 @@ def multi_hop(
     # fully async.
     sp = obs.current_span()
     ms = obs.NOOP if sp is None else sp.child("multi_hop")
+
     # one [cap]-shaped output means only ONE of the two donated carries
     # can alias; the visited buffer's fallback is contract-checked
     # (analysis/programs.py batch.multi_hop, donate_unused_ok) and
     # counted (dgraph_donation_fallback_total) instead of blanket-hidden
-    with expected_unusable_donation("ops.batch.multi_hop"), ms:
-        res = _multi_hop_jit(
-            offsets, dst, frontier, visited, n_hops, cap, track_visited, lut
-        )
-        if sp is not None:
-            ms.set_attr("hops", int(n_hops))
-            ms.set_attr("cap", int(cap))
-            ms.set_attr("track_visited", bool(track_visited))
-            ms.set_attr("device_sync_ms", round(obs.block_ready_ms(res), 3))
-        return res
+    def _dispatch():
+        fail.point("device.multi_hop")
+        with expected_unusable_donation("ops.batch.multi_hop"), ms:
+            res = _multi_hop_jit(
+                offsets, dst, frontier, visited, n_hops, cap,
+                track_visited, lut,
+            )
+            if sp is not None:
+                ms.set_attr("hops", int(n_hops))
+                ms.set_attr("cap", int(cap))
+                ms.set_attr("track_visited", bool(track_visited))
+                ms.set_attr(
+                    "device_sync_ms", round(obs.block_ready_ms(res), 3)
+                )
+            elif devguard.enabled():
+                # under the guard the SYNC POINT must sit inside the
+                # watchdog bracket — a wedged scan times out here on the
+                # guard's worker instead of at the caller's later fetch
+                obs.block_ready_ms(res)
+            return res
+
+    # devguard.run is a passthrough under DGRAPH_TPU_DEVGUARD=0 (fully
+    # async dispatch, faults propagate raw — the legacy path); callers
+    # (query/chain.py, query/recurse.py) catch DeviceFaultError and
+    # fall back to per-level execution
+    return devguard.get().run("device.multi_hop", _dispatch)
 
 
 @partial(

@@ -209,7 +209,16 @@ def sharded_expand_segments(
     merged union frontiers (sched/cohort.py::HopMerger) ride this path
     unchanged: K cross-request sharded dispatches become one, and each
     member's exact segments slice back out (tests/test_sched.py::
-    test_merged_hops_ride_mesh_path pins the contract)."""
+    test_merged_hops_ride_mesh_path pins the contract).
+
+    Fault domain: the engine runs this whole call under the "mesh"
+    device guard (query/engine.py::_mesh_expand), so the probe below
+    fires ON the guard's worker thread — ``hang(ms=)`` armed here wedges
+    the collective past the watchdog and the level re-plans unsharded,
+    ``error``/``xla_oom`` model a lost chip."""
+    from dgraph_tpu.utils.failpoints import fail
+
+    fail.point("device.mesh")
     fcap = _fcap_bucket(len(frontier))
     f = jnp.asarray(ops.pad_to(np.asarray(frontier, dtype=np.int64), fcap))
     step, total_slots = seg_expand_packed_step(mesh, cap, fcap)

@@ -45,6 +45,7 @@ import numpy as np
 from dgraph_tpu import ops
 from dgraph_tpu.ops.sets import SENT
 from dgraph_tpu.utils import planconfig
+from dgraph_tpu.utils.failpoints import fail
 
 # minimum estimated fan-out before fusing pays for itself (STATIC
 # fallback; the default route decision is the calibrated cost compare in
@@ -318,6 +319,15 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
 
     if len(src) == 0 or not eligible_level(engine, child):
         return reject("root level not fusable" if len(src) else "empty frontier")
+    from dgraph_tpu.utils import devguard
+
+    if not devguard.get().allowed():
+        # device fault domain latched sick: every fused route below is a
+        # device program, so decline the whole chain up front — the
+        # per-level path then rides the host mirrors until the half-open
+        # probe re-admits the backend (the planner's cost factor makes
+        # the same call when it is armed; this is the static-path seam)
+        return reject("device sick: per-level host execution (devguard)")
     src = np.asarray(src)
     if not np.all(src[1:] > src[:-1]):
         # expand_chunked's slot mapping requires an ascending-distinct
@@ -497,21 +507,31 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
         m = min(slots, nd)
         B = cap_u
 
-    metas, ovs, luts = [], [], []
-    for a in arenas:
-        mp, ov = a.inline_layout()
-        metas.append(mp)
-        ovs.append(ov)
-        luts.append(a.lut(universe))
-
-    root_vec = jnp.asarray(ops.pad_to(src, caps[0][0]))
-    packed = np.asarray(  # ONE device round trip for the whole chain
-        _run_fused(
-            root_vec, tuple(metas), tuple(ovs), tuple(luts),
-            tuple(keeps), tuple(orders), tuple(caps),
-            light=light,
+    def _dispatch():
+        # staging + dispatch + the ONE fetch, all inside the device
+        # guard's watchdog bracket: an HBM OOM uploading a layout
+        # classifies like a dispatch OOM, a wedged program times out
+        # here instead of blocking the flush worker
+        fail.point("device.chain")
+        metas, ovs, luts = [], [], []
+        for a in arenas:
+            mp, ov = a.inline_layout()
+            metas.append(mp)
+            ovs.append(ov)
+            luts.append(a.lut(universe))
+        root_vec = jnp.asarray(ops.pad_to(src, caps[0][0]))
+        return np.asarray(  # ONE device round trip for the whole chain
+            _run_fused(
+                root_vec, tuple(metas), tuple(ovs), tuple(luts),
+                tuple(keeps), tuple(orders), tuple(caps),
+                light=light,
+            )
         )
-    )
+
+    try:
+        packed = devguard.get().run("device.chain", _dispatch)
+    except devguard.DeviceFaultError:
+        return reject("device fault: chain fell back to per-level")
 
     # --- host conversion: packed buffer → engine results per level ---
     src_list = np.asarray(src, dtype=np.int64)
@@ -631,15 +651,24 @@ def _try_chain_scan(engine, levels, arena, src, est_edges, universe) -> bool:
     cap = ops.bucket(max(max(caps), len(src), 1))
     if cap > CHAIN_MAX_CAPC_LIGHT * ops.CHUNK:
         return False
-    arena.ensure_device()
-    lut = arena.lut(universe)
-    f = jnp.asarray(ops.pad_to(np.asarray(src, dtype=np.int64), cap))
-    vis = jnp.full((cap,), SENT, dtype=jnp.int32)
-    fs, totals, _vis = ops.multi_hop(
-        arena.offsets, arena.dst, f, vis, len(levels), cap, lut=lut
-    )
-    fs = np.asarray(fs)
-    totals = np.asarray(totals)
+    from dgraph_tpu.utils import devguard
+
+    try:
+        arena.ensure_device()
+        lut = arena.lut(universe)
+        f = jnp.asarray(ops.pad_to(np.asarray(src, dtype=np.int64), cap))
+        vis = jnp.full((cap,), SENT, dtype=jnp.int32)
+        # the scan driver is guard-bracketed inside ops.multi_hop: a
+        # wedged/sick/OOM dispatch surfaces here as DeviceFaultError
+        fs, totals, _vis = ops.multi_hop(
+            arena.offsets, arena.dst, f, vis, len(levels), cap, lut=lut
+        )
+        fs = np.asarray(fs)
+        totals = np.asarray(totals)
+    except devguard.DeviceFaultError:
+        # hot failover: decline the scan — the staged path (or, with
+        # the domain now sick, the per-level host path) takes over
+        return False
     src_list = np.asarray(src, dtype=np.int64)
     for i, sg in enumerate(levels):
         sg.chain_filtered = False

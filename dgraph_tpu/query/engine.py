@@ -31,8 +31,9 @@ from dgraph_tpu.models.types import TypeID, TypedValue, numeric, sort_key
 from dgraph_tpu.query.functions import FuncResolver, QueryError
 from dgraph_tpu.query.subgraph import SubGraph, build_subgraph
 from dgraph_tpu.query import outputnode, planner
-from dgraph_tpu.utils import planconfig
+from dgraph_tpu.utils import devguard, planconfig
 from dgraph_tpu.utils.failpoints import fail
+from dgraph_tpu.utils.metrics import DEVICE_FAILOVER
 
 _EMPTY = np.empty(0, dtype=np.int64)
 
@@ -108,6 +109,11 @@ def _fresh_stats() -> dict:
         # root-level `first: k` early termination (sched/qos.py gate):
         # number of root filters that stopped after enough survivors
         "first_early_exit": 0,
+        # device fault domain (utils/devguard.py): dispatches this
+        # request hot-failed over to a host route (wedged/sick/OOM
+        # device) — nonzero stamps the response's degraded.device
+        # annotation, the PR 5 stale-read disclosure device-flavored
+        "device_failover": 0,
     }
 
 
@@ -319,6 +325,91 @@ class DeviceExpander:
             planner.note_outcome(dec, actual_ms * 1e3)
         return out, seg_ptr
 
+    # -- device fault domain (utils/devguard.py) ----------------------------
+
+    def _count_failover(self, route: str) -> None:
+        """One hot failover off the device plane: per-request stat (the
+        response's degraded.device stamp) + the alertable series."""
+        devguard.count_failover(route, self.engine.stats)
+
+    def _run_guarded(self, op: str, fn):
+        """Run one dispatch+fetch closure under the device guard.
+        Returns the closure's result, or None after a classified device
+        fault — the caller then takes the host route (byte-identical by
+        the parity contracts).  HBM OOM gets ArenaManager LRU eviction
+        plus ONE retry before giving up on the device; DGRAPH_TPU_
+        DEVGUARD=0 calls the closure inline (legacy behavior, faults
+        propagate)."""
+        g = devguard.get()
+        if not devguard.enabled():
+            return fn()
+        try:
+            return g.run(op, fn)
+        except devguard.DeviceFaultError as e:
+            if e.kind == "oom" and self.engine.arenas.evict_for_oom():
+                # pressure valve: the budget is an estimate, the
+                # allocator's verdict is ground truth — free LRU arenas
+                # and re-prove the dispatch once
+                DEVICE_FAILOVER.add("evict_retry")
+                try:
+                    return g.run(op, fn)
+                except devguard.DeviceFaultError:
+                    pass
+            # the planner's expand decision must NOT be closed with the
+            # fallback's host latency — a failed dispatch is not a rate
+            # sample for the device route
+            self._expand_dec = None
+            self._count_failover("host")
+            return None
+
+    def _host_fallback(self, arena, rows) -> Tuple[np.ndarray, np.ndarray]:
+        """The hot-failover landing: serve this level off the host CSR
+        mirror — the same vectorized numpy route small expansions take,
+        byte-identical to every device route by the parity contracts."""
+        eng = self.engine
+        self._route = "host"
+        with obs.stage(eng.stats, "host_expand_ms"):
+            out, seg_ptr = arena.expand_host(rows)
+        eng.stats["edges"] += len(out)
+        return out, seg_ptr
+
+    def _mesh_expand(self, arena, src, attr, reverse, cap):
+        """Sharded expansion under the "mesh" fault domain.  Returns
+        (out, seg_ptr), or None when the mesh is latched sick or a chip
+        fault/wedged collective was classified — the caller then
+        re-plans this level unsharded (single-device or host), so a
+        lost mesh chip degrades one route, not the node."""
+        eng = self.engine
+        mg = devguard.get("mesh")
+        if not mg.allowed():
+            self._count_failover("unsharded")
+            return None
+        from dgraph_tpu.parallel.mesh import sharded_expand_segments
+
+        sharded = eng.arenas.sharded_csr(attr, reverse=reverse)
+
+        def _dispatch():
+            with obs.stage(eng.stats, "device_expand_ms"):
+                return sharded_expand_segments(
+                    eng.arenas.mesh, sharded, src, cap
+                )
+
+        if not devguard.enabled():
+            out, seg_ptr = _dispatch()
+        else:
+            try:
+                out, seg_ptr = mg.run("mesh.expand", _dispatch)
+            except devguard.DeviceFaultError:
+                self._count_failover("unsharded")
+                return None
+        self._route = "mesh"
+        eng.stats["edges"] += len(out)
+        led = _ledger.current()
+        if led is not None:
+            led.bytes_h2d += int(src.nbytes)
+            led.bytes_d2h += int(out.nbytes + seg_ptr.nbytes)
+        return out, seg_ptr
+
     def _expand_one_inner(
         self, arena, src: np.ndarray, attr: str = "", reverse: bool = False
     ) -> Tuple[np.ndarray, np.ndarray]:
@@ -326,7 +417,14 @@ class DeviceExpander:
         for the reference's per-key loop, worker/task.go:287-440).  Big
         predicates on a multi-device mesh expand sharded: each device owns
         a uid range of rows, results merge via all_gather (SURVEY §2b —
-        intra-predicate sharding the reference lacks)."""
+        intra-predicate sharding the reference lacks).
+
+        Every device dispatch+fetch below runs bracketed by the device
+        guard (utils/devguard.py): a wedged dispatch times out on the
+        watchdog instead of blocking this worker forever, a classified
+        fault hot-fails the level over to ``_host_fallback``, and a
+        sick backend is priced out by the planner (or shed at the seam
+        on the static path) until the half-open probe re-admits it."""
         eng = self.engine
         n = len(src)
         if n == 0 or arena.n_edges == 0:
@@ -339,20 +437,11 @@ class DeviceExpander:
             return _EMPTY, np.zeros(n + 1, dtype=np.int64)
         cap = ops.bucket(total)
         if attr and eng.arenas.use_mesh_for(arena):
-            from dgraph_tpu.parallel.mesh import sharded_expand_segments
-
-            sharded = eng.arenas.sharded_csr(attr, reverse=reverse)
-            self._route = "mesh"
-            with obs.stage(eng.stats, "device_expand_ms"):
-                out, seg_ptr = sharded_expand_segments(
-                    eng.arenas.mesh, sharded, src, cap
-                )
-            eng.stats["edges"] += len(out)
-            led = _ledger.current()
-            if led is not None:
-                led.bytes_h2d += int(src.nbytes)
-                led.bytes_d2h += int(out.nbytes + seg_ptr.nbytes)
-            return out, seg_ptr
+            got = self._mesh_expand(arena, src, attr, reverse, cap)
+            if got is not None:
+                return got
+            # mesh chip-loss / wedged collective: fall through — the
+            # level re-plans unsharded onto the routes below
         # host-vs-device: calibrated break-even by default (the
         # size-adaptive routing the reference does per-intersection,
         # algo/uidlist.go:56-64, priced from MEASURED rates instead of a
@@ -362,15 +451,16 @@ class DeviceExpander:
         if dec is not None:
             planner.record(eng.stats, dec)
             self._expand_dec = dec
+        if use_device and not devguard.get().allowed():
+            # sick device on the STATIC path (planner off / knob
+            # pinned — the armed planner already priced it out above)
+            use_device = False
+            self._count_failover("host")
         if not use_device:
             # small expansion: vectorized numpy over the host CSR mirror —
             # a device dispatch costs a transport round trip that dwarfs
             # the work
-            self._route = "host"
-            with obs.stage(eng.stats, "host_expand_ms"):
-                out, seg_ptr = arena.expand_host(rows)
-            eng.stats["edges"] += len(out)
-            return out, seg_ptr
+            return self._host_fallback(arena, rows)
         # big single-device expansion.  The inline-head fast path (one
         # 32B row gather serves metadata + the first INLINE targets;
         # docs/ROOFLINE.md round 4) and the classed-gather path both
@@ -380,12 +470,20 @@ class DeviceExpander:
         ascending = bool(np.all(valid_rows[1:] > valid_rows[:-1]))
         if ascending and self._use_classed():
             self._route = "classed"
-            with obs.stage(eng.stats, "device_expand_ms"):
-                arena.ensure_device()  # re-upload after incremental deltas
-                ce = ops.classed_for_arena(arena)
-                out, seg_ptr = ce.expand_rows(
-                    rows, arena.degree_of_rows(rows)
-                )
+
+            def _dispatch_classed():
+                fail.point("device.hop")
+                with obs.stage(eng.stats, "device_expand_ms"):
+                    arena.ensure_device()  # re-upload after deltas
+                    ce = ops.classed_for_arena(arena)
+                    return ce.expand_rows(
+                        rows, arena.degree_of_rows(rows)
+                    )
+
+            got = self._run_guarded("device.hop", _dispatch_classed)
+            if got is None:
+                return self._host_fallback(arena, rows)
+            out, seg_ptr = got
             eng.stats["edges"] += len(out)
             led = _ledger.current()
             if led is not None:
@@ -394,29 +492,43 @@ class DeviceExpander:
             return out, seg_ptr
         if ascending:
             self._route = "inline"
-            metap, ov_chunks = arena.inline_layout()
             B = ops.bucket(n)
             capov = ops.bucket(
                 max(1, int(arena.ov_chunk_degree_of_rows(rows).sum()))
             )
-            with obs.stage(eng.stats, "device_expand_ms"):
-                dev = _packed_expand_inline(
-                    metap, ov_chunks, ops.pad_rows(rows, B), capov
-                )
-                if self._span is not None:
-                    # sampled: split pure device time from the host fetch
-                    # (the unsampled path stays dispatch-async — asarray
-                    # overlaps the compute with the host bookkeeping)
-                    sync_ms = obs.block_ready_ms(dev)
-                    self._span.set_attr(
-                        "device_sync_ms", round(sync_ms, 3)
+
+            def _dispatch_inline():
+                fail.point("device.hop")
+                # staging inside the bracket: an HBM OOM uploading the
+                # inline layout classifies like a dispatch OOM.  The
+                # closure returns plain data — ledger/span writes happen
+                # on the CALLER thread below, so an abandoned (wedged)
+                # worker waking up later can never scribble on a pooled
+                # struct a newer request now owns
+                metap, ov_chunks = arena.inline_layout()
+                with obs.stage(eng.stats, "device_expand_ms"):
+                    dev = _packed_expand_inline(
+                        metap, ov_chunks, ops.pad_rows(rows, B), capov
                     )
-                    led = _ledger.current()
-                    if led is not None:
-                        led.device_sync_ms += sync_ms
-                # one fetch: inline|ov|ovseg concatenated on device
-                packed = np.asarray(dev)
+                    # sampled: split pure device time from the host
+                    # fetch (the unsampled path stays dispatch-async —
+                    # asarray overlaps compute with bookkeeping)
+                    sync_ms = (
+                        obs.block_ready_ms(dev)
+                        if self._span is not None else None
+                    )
+                    # one fetch: inline|ov|ovseg concatenated on device
+                    return np.asarray(dev), sync_ms
+
+            got = self._run_guarded("device.hop", _dispatch_inline)
+            if got is None:
+                return self._host_fallback(arena, rows)
+            packed, sync_ms = got
             led = _ledger.current()
+            if sync_ms is not None and self._span is not None:
+                self._span.set_attr("device_sync_ms", round(sync_ms, 3))
+                if led is not None:
+                    led.device_sync_ms += sync_ms
             if led is not None:
                 led.bytes_h2d += int(rows.nbytes)
                 led.bytes_d2h += int(packed.nbytes)
@@ -426,23 +538,33 @@ class DeviceExpander:
             eng.stats["edges"] += len(out)
             return out, seg_ptr
         self._route = "csr"
-        with obs.stage(eng.stats, "device_expand_ms"):
-            arena.ensure_device()  # re-upload after incremental host deltas
-            dev = _packed_expand_csr(
-                arena.offsets, arena.dst,
-                ops.pad_rows(rows, ops.bucket(n)), cap,
-            )
-            if self._span is not None:
-                sync_ms = obs.block_ready_ms(dev)
-                self._span.set_attr(
-                    "device_sync_ms", round(sync_ms, 3)
+
+        def _dispatch_csr():
+            fail.point("device.hop")
+            # plain-data return: ledger/span writes stay on the caller
+            # thread (see _dispatch_inline's abandoned-worker note)
+            with obs.stage(eng.stats, "device_expand_ms"):
+                arena.ensure_device()  # re-upload after host deltas
+                dev = _packed_expand_csr(
+                    arena.offsets, arena.dst,
+                    ops.pad_rows(rows, ops.bucket(n)), cap,
                 )
-                led = _ledger.current()
-                if led is not None:
-                    led.device_sync_ms += sync_ms
-            # one fetch: out|seg concatenated on device
-            packed = np.asarray(dev)
+                sync_ms = (
+                    obs.block_ready_ms(dev)
+                    if self._span is not None else None
+                )
+                # one fetch: out|seg concatenated on device
+                return np.asarray(dev), sync_ms
+
+        got = self._run_guarded("device.hop", _dispatch_csr)
+        if got is None:
+            return self._host_fallback(arena, rows)
+        packed, sync_ms = got
         led = _ledger.current()
+        if sync_ms is not None and self._span is not None:
+            self._span.set_attr("device_sync_ms", round(sync_ms, 3))
+            if led is not None:
+                led.device_sync_ms += sync_ms
         if led is not None:
             led.bytes_h2d += int(rows.nbytes)
             led.bytes_d2h += int(packed.nbytes)
@@ -582,6 +704,26 @@ class QueryEngine:
                 info = deg(preds=lambda: referenced_preds(parsed.queries))
                 if info:
                     out["degraded"] = info
+            # device fault domain (utils/devguard.py): a request that
+            # hot-failed device dispatches over to host routes says so —
+            # the results are byte-identical (parity contracts), only
+            # slower, and the client deserves the same freshness-style
+            # disclosure stale reads carry.  Absent on every fault-free
+            # request (and under DGRAPH_TPU_DEVGUARD=0), so the healthy
+            # response stays byte-identical.
+            if self.stats.get("device_failover"):
+                out.setdefault("degraded", {})["device"] = {
+                    "failovers": int(self.stats["device_failover"]),
+                    "domains": {
+                        d: {
+                            "state": s["state"],
+                            "last_fault": s["last_fault"],
+                            "retry_after": s["cooldown_s"],
+                        }
+                        for d, s in devguard.summary().items()
+                        if s["state"] != "healthy" or s["faults"]
+                    },
+                }
         elif parsed.mutation is not None and "schema" not in out:
             out["code"] = "Success"
             out["message"] = "Done"

@@ -43,7 +43,8 @@ import numpy as np
 
 from dgraph_tpu import obs, ops
 from dgraph_tpu.ops.sets import SENT
-from dgraph_tpu.utils import planconfig
+from dgraph_tpu.utils import devguard, planconfig
+from dgraph_tpu.utils.failpoints import fail
 from dgraph_tpu.utils.metrics import JOIN_ROUTES, KWAY_INTERSECTS
 
 _EMPTY = np.empty(0, dtype=np.int64)
@@ -157,21 +158,42 @@ def kway_intersect(
     if dec is not None:
         planner.record(stats, dec)
     k0 = stats.get("kway_ms", 0.0) if stats is not None else 0.0
+    if use_device and not devguard.get().allowed():
+        # sick device on the static/forced path (the armed planner's
+        # cost factor already priced the device branch out above); the
+        # reroute is disclosed like every other failover
+        use_device = False
+        dec = None  # the fold below is not a sample for either route
+        devguard.count_failover("host", stats)
     if use_device:
         import jax.numpy as jnp
 
-        with obs.stage(stats if stats is not None else {}, "kway_ms"):
-            L = ops.bucket(max(len(s) for s in sets))
-            mat = np.stack([ops.pad_to(s, L) for s in sets])
-            out = np.asarray(ops.intersect_stack(jnp.asarray(mat)))
-            res = out[out != SENT].astype(np.int64)
-        KWAY_INTERSECTS.add("device")
-        with _ROUTE_LOCK:
-            _COUNTS["kway_device"] += 1
-        if stats is not None:
-            stats["kway_device"] = stats.get("kway_device", 0) + 1
-            planner.note_outcome(dec, (stats["kway_ms"] - k0) * 1e3)
-        return res
+        def _dispatch():
+            fail.point("device.spgemm")
+            with obs.stage(stats if stats is not None else {}, "kway_ms"):
+                L = ops.bucket(max(len(s) for s in sets))
+                mat = np.stack([ops.pad_to(s, L) for s in sets])
+                out = np.asarray(ops.intersect_stack(jnp.asarray(mat)))
+                return out[out != SENT].astype(np.int64)
+
+        try:
+            res = devguard.get().run("device.spgemm", _dispatch)
+        except devguard.DeviceFaultError:
+            # hot failover: the numpy fold below is byte-identical by
+            # construction (sorted-unique int64 either way).  The
+            # decision is dropped, not closed — the aborted attempt +
+            # host fold is not a rate sample for the device route
+            res = None
+            dec = None
+            devguard.count_failover("host", stats)
+        if res is not None:
+            KWAY_INTERSECTS.add("device")
+            with _ROUTE_LOCK:
+                _COUNTS["kway_device"] += 1
+            if stats is not None:
+                stats["kway_device"] = stats.get("kway_device", 0) + 1
+                planner.note_outcome(dec, (stats["kway_ms"] - k0) * 1e3)
+            return res
     with obs.stage(stats if stats is not None else {}, "kway_ms"):
         out = sets[0]
         for s in sets[1:]:
@@ -277,6 +299,11 @@ def try_mxu_route(engine, child, src: np.ndarray, resolver) -> bool:
     driver produces) and returns True."""
     mode = mxu_mode()
     if mode == "0" or len(src) == 0:
+        return False
+    if not devguard.get().allowed():
+        # device fault domain latched sick: the tile tier IS device
+        # programs — decline before any tile build, the pairwise path's
+        # expansions hot-fail to host (utils/devguard.py)
         return False
     # light (var-block) chains only: masks carry SETS, not uid matrices,
     # so any level whose results must be encoded cannot ride this tier
@@ -460,8 +487,13 @@ def try_mxu_route(engine, child, src: np.ndarray, resolver) -> bool:
 
     sp = obs.current_span()
     hs = sp.child("hop") if sp is not None else obs.NOOP
-    with hs, obs.stage(engine.stats, "mxu_join_ms"):
-        src32 = np.asarray(src, dtype=np.int64)
+
+    src32 = np.asarray(src, dtype=np.int64)
+
+    def _dispatch():
+        # mask staging + the whole tile-program chain + the fetch, all
+        # inside the device guard's watchdog bracket
+        fail.point("device.spgemm")
         x0 = spgemm.uids_to_mask(
             jnp.asarray(ops.pad_to(src32, ops.bucket(max(1, len(src32))))), m
         )
@@ -492,8 +524,18 @@ def try_mxu_route(engine, child, src: np.ndarray, resolver) -> bool:
                 "device_sync_ms",
                 round(obs.block_ready_ms((masks_dev, totals_dev)), 3),
             )
-        masks = np.asarray(masks_dev)
-        totals = np.asarray(totals_dev)
+        return np.asarray(masks_dev), np.asarray(totals_dev)
+
+    with hs, obs.stage(engine.stats, "mxu_join_ms"):
+        try:
+            masks, totals = devguard.get().run("device.spgemm", _dispatch)
+        except devguard.DeviceFaultError:
+            # hot failover: decline the tile tier — the pairwise gather
+            # chain (host-routed while the domain is sick) takes over;
+            # the recorded mxu decision stands, the reroute is counted
+            # (no note_outcome: a failed dispatch is not a rate sample)
+            devguard.count_failover("host", engine.stats)
+            return False
     planner.note_outcome(
         pdec, (engine.stats.get("mxu_join_ms", 0.0) - mxu_ms0) * 1e3
     )

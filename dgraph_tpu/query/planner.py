@@ -72,6 +72,16 @@ _MISPREDICT_SELF_MARGIN = 8.0
 # compiles / host outliers, not routing evidence
 _OUTLIER_FACTOR = 100.0
 
+
+def _device_factor() -> float:
+    """Device fault-domain pricing (utils/devguard.py): 1.0 while the
+    backend may be dispatched to, a large price-out multiplier while it
+    is latched sick — the planner then routes every decision host-side
+    without any route growing a sick-device special case."""
+    from dgraph_tpu.utils import devguard
+
+    return devguard.cost_factor()
+
 _LOCK = threading.Lock()
 _RECENT: "deque[dict]" = deque(maxlen=64)
 _COUNTS: dict = {}
@@ -268,12 +278,17 @@ def chain_route(
     ):
         return est_total >= engine.chain_threshold, None
     r = rates()
+    # the device fault domain's pricing hook: a sick backend multiplies
+    # every device-route cost (utils/devguard.py cost_factor) so it
+    # loses each break-even instead of being special-cased per route
+    df = _device_factor()
     host_c = n_levels * r["host_setup_us"] + est_total * r["host_edge_us"]
-    dev_c = n_levels * r["dispatch_us"] + est_total * (
-        r["device_edge_us"] + r["host_touch_us"]
+    dev_c = df * (
+        n_levels * r["dispatch_us"]
+        + est_total * (r["device_edge_us"] + r["host_touch_us"])
     )
     per_level = min(host_c, dev_c)
-    chain_c = (
+    chain_c = df * (
         r["dispatch_us"] + r["chain_plan_us"] + est_total * r["device_edge_us"]
     )
     fuse = chain_c < per_level
@@ -307,7 +322,9 @@ def expand_route(
         return total >= configured_min, None
     r = rates()
     host_c = r["host_setup_us"] + total * r["host_edge_us"]
-    dev_c = r["dispatch_us"] + total * r["device_edge_us"]
+    dev_c = _device_factor() * (
+        r["dispatch_us"] + total * r["device_edge_us"]
+    )
     use_device = dev_c < host_c
     dec = {
         "kind": "expand",
@@ -333,7 +350,7 @@ def merge_gate(est_edges: float, configured_min: int) -> bool:
         return est_edges >= configured_min
     r = rates()
     return (
-        r["dispatch_us"] + est_edges * r["device_edge_us"]
+        _device_factor() * (r["dispatch_us"] + est_edges * r["device_edge_us"])
         < r["host_setup_us"] + est_edges * r["host_edge_us"]
     )
 
@@ -346,7 +363,9 @@ def kway_route(total: int, k: int) -> Tuple[Optional[bool], Optional[dict]]:
         return None, None
     r = rates()
     host_c = k * r["host_setup_us"] + total * r["host_intersect_us"]
-    dev_c = r["dispatch_us"] + total * r["device_intersect_us"]
+    dev_c = _device_factor() * (
+        r["dispatch_us"] + total * r["device_intersect_us"]
+    )
     use_device = dev_c < host_c
     dec = {
         "kind": "kway",

@@ -162,16 +162,24 @@ def _try_fused_recurse(engine, sg: SubGraph, uid_templates) -> bool:
     if total_bound > MAX_EDGES:
         return False
     cap = ops.bucket(max(max(bounds), len(frontier) + nd, 1))
-    arena.ensure_device()
-    universe = int(arena.h_src[-1]) if arena.n_rows else 0
-    lut = arena.lut(universe)
-    f = jnp.asarray(ops.pad_to(frontier.astype(np.int64), cap))
-    vis = jnp.asarray(ops.pad_to(frontier.astype(np.int64), cap))
-    fs, totals, _vis = ops.multi_hop(
-        arena.offsets, arena.dst, f, vis, depth, cap,
-        track_visited=True, lut=lut,
-    )
-    fs = np.asarray(fs)
+    from dgraph_tpu.utils import devguard
+
+    try:
+        arena.ensure_device()
+        universe = int(arena.h_src[-1]) if arena.n_rows else 0
+        lut = arena.lut(universe)
+        f = jnp.asarray(ops.pad_to(frontier.astype(np.int64), cap))
+        vis = jnp.asarray(ops.pad_to(frontier.astype(np.int64), cap))
+        # guard-bracketed inside ops.multi_hop: a wedged/sick/OOM scan
+        # surfaces here as DeviceFaultError and the general level-by-
+        # level loop (whose expansions hot-fail to host) takes over
+        fs, totals, _vis = ops.multi_hop(
+            arena.offsets, arena.dst, f, vis, depth, cap,
+            track_visited=True, lut=lut,
+        )
+        fs = np.asarray(fs)
+    except devguard.DeviceFaultError:
+        return False
     engine.stats["edges"] += int(np.asarray(totals).astype(np.int64).sum())
     parent = sg
     prev = sg.dest_uids

@@ -274,6 +274,65 @@ class CancelRegistry:
 REGISTRY = CancelRegistry()
 
 
+def socket_disconnect_probe(sock) -> Callable[[], bool]:
+    """Transport-liveness probe for cooperative cancellation: returns a
+    zero-argument callable that reports True when the client is GONE.
+
+    Plain TCP: a closed client connection makes the socket readable
+    with EOF; ``MSG_PEEK`` observes that without consuming pipelined
+    bytes.
+
+    TLS (``ssl.SSLSocket``): ``recv`` flags are rejected at the SSL
+    layer, so the probe peeks the RAW transport instead — a second
+    socket object over the same fd (``socket.socket(fileno=...)``,
+    detached after the peek so the shared fd never closes) sees the
+    TCP FIN exactly like the plain probe.  Order matters: buffered
+    decrypted bytes (``sock.pending()``) mean the client was alive at
+    least as recently as those records, so the probe reports connected
+    without touching the fd; a readable raw socket with bytes (a TLS
+    record we must not consume) also reports connected — only a raw
+    EOF is a disconnect verdict.  close_notify without FIN therefore
+    reads as "still connected": a conservative miss, the deadline and
+    /admin/cancel paths still cover it.
+    """
+    import select
+    import socket as _socket
+    import ssl as _ssl
+
+    if isinstance(sock, _ssl.SSLSocket):
+        def gone_tls() -> bool:
+            try:
+                if sock.pending():
+                    return False  # undrained decrypted bytes: alive
+                r, _w, _x = select.select([sock], [], [], 0)
+                if not r:
+                    return False
+                raw = _socket.socket(fileno=sock.fileno())
+                try:
+                    return raw.recv(1, _socket.MSG_PEEK) == b""
+                finally:
+                    # detach BEFORE gc: the temp object must never close
+                    # the fd it shares with the live SSLSocket
+                    raw.detach()
+            except ValueError:
+                return False  # fd already detached mid-probe
+            except OSError:
+                return True   # socket already torn down
+        return gone_tls
+
+    def gone() -> bool:
+        try:
+            r, _w, _x = select.select([sock], [], [], 0)
+            if not r:
+                return False
+            return sock.recv(1, _socket.MSG_PEEK) == b""
+        except ValueError:
+            return False  # unexpected flag rejection: fail open
+        except OSError:
+            return True   # socket already torn down
+    return gone
+
+
 # -------------------------------------------------------------- deadlines
 
 

@@ -639,6 +639,15 @@ def _make_handler(srv: DgraphServer):
                         # disk plane: read-only latch, WAL growth,
                         # snapshot age, last recovery (models/wal.py)
                         detail["storage"] = status()
+                    # device fault domain (utils/devguard.py): per-domain
+                    # health state machine, fault/failover counters, and
+                    # the re-admission probe's score card
+                    from dgraph_tpu.utils import devguard as _devguard
+
+                    detail["device"] = {
+                        "enabled": _devguard.enabled(),
+                        "domains": _devguard.summary(),
+                    }
                     code = 200 if srv.health.ok() else 503
                     self._reply(code, json.dumps(detail).encode())
                 elif srv.health.ok():
@@ -931,30 +940,16 @@ def _make_handler(srv: DgraphServer):
         def _disconnect_probe(self):
             """Transport-liveness probe for cooperative cancellation
             (None when QoS is off — zero overhead on the legacy path).
-            A closed client connection makes the socket readable with
-            EOF; MSG_PEEK observes that without consuming pipelined
-            bytes.  TLS sockets reject recv flags — there the probe
-            reports 'still connected' (deadline and /admin/cancel still
-            work; documented in docs/deploy.md)."""
+            Both transports route through the shared helper
+            (sched/qos.py::socket_disconnect_probe): plain TCP peeks
+            the socket for EOF without consuming pipelined bytes; TLS
+            checks the SSL layer's buffered-pending first and peeks the
+            RAW fd for the FIN (recv flags are rejected at the SSL
+            layer), so a vanished HTTPS client cancels cooperatively
+            too."""
             if srv.scheduler is None or srv.scheduler.qos is None:
                 return None
-            import select
-            import socket as _socket
-
-            sock = self.connection
-
-            def gone() -> bool:
-                try:
-                    r, _w, _x = select.select([sock], [], [], 0)
-                    if not r:
-                        return False
-                    return sock.recv(1, _socket.MSG_PEEK) == b""
-                except ValueError:
-                    return False  # SSLSocket: flags unsupported
-                except OSError:
-                    return True   # socket already torn down
-
-            return gone
+            return _qos.socket_disconnect_probe(self.connection)
 
         def _cluster_authorized(self) -> bool:
             """Gate for the intra-cluster control plane (/raft*, /assign-uids):

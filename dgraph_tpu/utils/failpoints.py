@@ -23,6 +23,19 @@ Actions:
   *inside* a chosen code site.  The crash-recovery matrix
   (tests/test_crash_matrix.py) arms this inside real server
   subprocesses at every durability-critical site.
+- ``hang(ms=, p=, n=, after=)`` — sleep ``ms`` at the site: a WEDGED
+  dispatch, not a slow one.  Functionally a delay, named apart because
+  chaos specs read differently: armed at a ``device.*`` dispatch site
+  with ``ms`` past ``DGRAPH_TPU_DEVICE_HANG_MS``, the device guard's
+  watchdog (utils/devguard.py) times out the sync, latches the backend
+  SICK and hot-fails the query over to the host route while the wedged
+  dispatch thread sleeps it off.
+- ``xla_oom(p=, n=, ms=, after=)`` — raise an XLA-shaped
+  ``RESOURCE_EXHAUSTED`` runtime error (the real ``XlaRuntimeError``
+  class when jaxlib exposes one, so the device guard's exception
+  classifier cannot tell an injected HBM OOM from a real one).  Armed
+  at arena/tile staging sites it drives the OOM recovery path: LRU
+  eviction + one retry before host fallback.
 
 ``p`` is the trigger probability (default 1.0), ``n`` caps how many
 times the action fires (default unlimited), ``after`` skips the first
@@ -62,7 +75,24 @@ class FailpointError(OSError):
     be able to tell an injected failure from a real network one."""
 
 
-_ACTION_RE = re.compile(r"^(error|delay|crash)\s*(?:\((.*)\))?$")
+_ACTION_RE = re.compile(r"^(error|delay|crash|hang|xla_oom)\s*(?:\((.*)\))?$")
+
+
+def _xla_oom_error(site: str) -> BaseException:
+    """An injected HBM OOM, raised as the REAL XlaRuntimeError class
+    when jaxlib exposes one — resilience code (devguard's classifier)
+    must not be able to tell it from a genuine allocation failure."""
+    msg = (
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        f"1073741824 bytes. (failpoint {site!r} injected)"
+    )
+    try:
+        from jax._src.lib import xla_client
+
+        return xla_client.XlaRuntimeError(msg)
+    except Exception:  # noqa: BLE001 — jaxlib layout varies; the
+        # classifier keys on the RESOURCE_EXHAUSTED marker either way
+        return RuntimeError(msg)
 
 
 class _Action:
@@ -190,6 +220,8 @@ class Failpoints:
             os._exit(86)
         if kind == "error":
             raise FailpointError(f"failpoint {site!r} injected error")
+        if kind == "xla_oom":
+            raise _xla_oom_error(site)
 
     def hits(self, site: str) -> int:
         with self._lock:

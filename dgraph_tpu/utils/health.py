@@ -1,11 +1,33 @@
-"""Health gate: serve /health 503 until the engine is initialised.
+"""Health primitives shared across the fault domains.
 
-Equivalent of x/health.go:51 — the reference only answers OK after the
-raft nodes are up (worker/groups.go:174)."""
+Three subsystems latch themselves unhealthy and re-prove themselves with
+a cooldown-first half-open probe: the per-(peer, op) circuit breaker
+(cluster/peerclient.py), the storage read-only latch
+(models/durability.py) and the device guard (utils/devguard.py).  They
+grew three near-copies of the same two disciplines, so both live here
+exactly once:
+
+- :class:`HalfOpenGate` — the probe-SLOT discipline: after a cooldown,
+  exactly ONE caller at a time holds the half-open probe slot, owns it
+  via a token (a slow call admitted under an earlier state must never
+  release a slot it does not hold), and hands it back on every exit
+  path.
+- :class:`CooldownProbeLoop` — the background RE-PROVE discipline:
+  cooldown FIRST (the fault just happened; re-proving the resource in
+  the same microsecond mostly proves nothing and would flap a
+  failpoint-injected fault instantly), then one probe per interval on a
+  single daemon thread until the probe heals the latch or the owner
+  stops.
+
+Plus :class:`HealthGate`, the boot-readiness bit behind ``/health``
+(equivalent of x/health.go:51 — the reference only answers OK after the
+raft nodes are up, worker/groups.go:174).
+"""
 
 from __future__ import annotations
 
 import threading
+from typing import Callable, Optional, Tuple
 
 
 class HealthGate:
@@ -20,3 +42,110 @@ class HealthGate:
 
     def ok(self) -> bool:
         return self._ok.is_set()
+
+
+class HalfOpenGate:
+    """Single-probe admission for an OPEN/SICK circuit.
+
+    NOT thread-safe on its own: the owner calls every method under its
+    own state lock (the gate is a few fields of that state, not a new
+    lock — a second lock here would buy deadlock risk for nothing).
+
+    Lifecycle: ``open(now)`` (re)starts the cooldown and clears the
+    probe slot; ``admit(now, cooldown, half_open)`` grants the slot to
+    exactly one caller once the cooldown elapsed (``half_open=True``
+    skips the cooldown check — the circuit already transitioned, only
+    the slot matters); ``release(token)`` frees the slot WITHOUT
+    judging the resource, stale tokens are no-ops.
+    """
+
+    __slots__ = ("opened_at", "probe_inflight", "probe_token")
+
+    def __init__(self):
+        self.opened_at = 0.0
+        self.probe_inflight = False
+        self.probe_token = 0  # ownership of the half-open probe slot
+
+    def open(self, now: float) -> None:
+        """(Re-)enter the open state: restart the cooldown clock and
+        clear the probe slot (the failed prober's release becomes a
+        stale-token no-op)."""
+        self.opened_at = now
+        self.probe_inflight = False
+
+    def admit(
+        self, now: float, cooldown: float, half_open: bool
+    ) -> Tuple[bool, float, Optional[int]]:
+        """(granted, retry_after, probe_token).  A non-None token means
+        the caller HOLDS the probe slot and must hand it back to
+        :meth:`release` on every exit path, or the circuit wedges
+        shedding forever."""
+        if not half_open:
+            waited = now - self.opened_at
+            if waited < cooldown:
+                return False, cooldown - waited, None
+        if self.probe_inflight:
+            return False, cooldown, None
+        self.probe_inflight = True
+        self.probe_token += 1
+        return True, 0.0, self.probe_token
+
+    def release(self, token: Optional[int]) -> None:
+        """Free the probe slot without judging the resource.  A stale
+        token (the slot was re-granted to a newer probe after
+        :meth:`open` cleared it) is a no-op."""
+        if token is not None and self.probe_token == token:
+            self.probe_inflight = False
+
+
+class CooldownProbeLoop:
+    """Background re-prove loop: sleep one interval FIRST, then probe
+    once per interval on a single daemon thread.
+
+    ``probe`` returns True when the resource healed (the loop exits);
+    ``active`` returns False when probing should stop (owner stopped,
+    or the latch already cleared some other way).  ``start()`` is
+    idempotent while a loop thread is alive — a storm of concurrent
+    faults spawns at most one prober.
+    """
+
+    def __init__(
+        self,
+        probe: Callable[[], bool],
+        interval_s: float,
+        active: Callable[[], bool],
+        name: str = "dgraph-probe",
+    ):
+        self._probe = probe
+        self.interval_s = interval_s
+        self._active = active
+        self._name = name
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> bool:
+        """Spawn the loop unless one is already running; returns whether
+        this call spawned it."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return False
+            self._thread = threading.Thread(
+                target=self._loop, name=self._name, daemon=True
+            )
+            t = self._thread
+        t.start()
+        return True
+
+    def _loop(self) -> None:
+        import time
+
+        while True:
+            # cooldown FIRST (half-open semantics): give the condition
+            # one interval to clear before re-proving anything
+            if not self._active():
+                return
+            time.sleep(self.interval_s)
+            if not self._active():
+                return
+            if self._probe():
+                return

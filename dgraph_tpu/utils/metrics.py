@@ -756,6 +756,31 @@ XLA_COMPILE_SECONDS = metrics.histogram(
 )
 
 
+# device fault domain (utils/devguard.py): DEVICE_STATE mirrors the
+# breaker-gauge convention (0 healthy, 1 suspect, 2 sick), one series
+# per fault domain ("device" = the default backend's dispatch plane,
+# "mesh" = the multi-chip collective plane — a lost mesh chip must not
+# brand single-device dispatch sick).  Every classified fault lands in
+# DEVICE_FAULTS{kind ∈ hang/oom/transient/sick}; every hot failover the
+# sick path took in DEVICE_FAILOVER{route ∈ host/unsharded/evict_retry}.
+# Alert on the failover RATE: a sustained nonzero rate means queries
+# are being served correct-but-slower off the host mirrors while the
+# device re-proves itself.  DEVICE_PROBES counts half-open re-admission
+# probes by outcome (ok/fail).
+DEVICE_STATE = metrics.labeled_gauge(
+    "dgraph_device_state", label="domain"
+)
+DEVICE_FAULTS = metrics.labeled(
+    "dgraph_device_faults_total", label="kind"
+)
+DEVICE_FAILOVER = metrics.labeled(
+    "dgraph_device_failover_total", label="route"
+)
+DEVICE_PROBES = metrics.labeled(
+    "dgraph_device_probes_total", label="outcome"
+)
+
+
 # build identity + liveness: BUILD_INFO is the constant-1 gauge whose
 # labels carry what is running (the client_golang BuildInfo
 # convention; obs/device.py stamps it once the backend is known), and

@@ -1353,3 +1353,94 @@ def test_program_factory_live_coverage_names_real_sites():
         "dgraph_tpu/utils/calibrate.py::measure.gather",
     ):
         assert key in cov, key
+
+
+# ------------------------------------------------------- naked-device-sync
+
+def test_naked_device_sync_flags_host_level_sync_points():
+    from dgraph_tpu.analysis.rules import NakedDeviceSync
+
+    src = textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        def serve_hop(program, rows):
+            dev = program(rows)
+            dev.block_until_ready()
+            jax.block_until_ready(dev)
+            return int(dev.sum().item())
+    """)
+    findings = check_source(
+        src, [NakedDeviceSync()], path="dgraph_tpu/query/newexec.py"
+    )
+    assert [f.rule for f in findings] == ["naked-device-sync"] * 3
+
+
+def test_naked_device_sync_scoped_to_serving_dirs():
+    from dgraph_tpu.analysis.rules import NakedDeviceSync
+
+    src = "def f(x):\n    return x.block_until_ready()\n"
+    # utils/ (devguard's home) and obs/ (block_ready_ms) are exempt by
+    # scoping; the four serving layers are covered
+    assert check_source(
+        src, [NakedDeviceSync()], path="dgraph_tpu/utils/devguard.py"
+    ) == []
+    assert check_source(
+        src, [NakedDeviceSync()], path="dgraph_tpu/obs/spans.py"
+    ) == []
+    for d in ("query", "ops", "parallel", "sched"):
+        got = check_source(
+            src, [NakedDeviceSync()], path=f"dgraph_tpu/{d}/x.py"
+        )
+        assert [f.rule for f in got] == ["naked-device-sync"], d
+
+
+def test_naked_device_sync_counterexamples_not_flagged():
+    from dgraph_tpu.analysis.rules import NakedDeviceSync
+
+    src = textwrap.dedent("""
+        import jax
+        from dgraph_tpu import obs
+        from dgraph_tpu.utils import devguard
+
+        def guarded_hop(program, rows):
+            # the sanctioned spellings: the guard's watchdog bracket and
+            # the span-attributed block helper
+            res = devguard.get().run("device.hop", lambda: program(rows))
+            obs.block_ready_ms(res)
+            return res
+
+        @jax.jit
+        def traced(x):
+            # in-jit sync points belong to host-sync-in-jit, not this
+            # rule (one finding per bug class)
+            return x.sum().item()
+    """)
+    assert check_source(
+        src, [NakedDeviceSync()], path="dgraph_tpu/ops/newkernel.py"
+    ) == []
+
+
+def test_naked_device_sync_pragma_suppresses_with_why():
+    from dgraph_tpu.analysis.rules import NakedDeviceSync
+
+    src = textwrap.dedent("""
+        def host_count(counts_np):
+            # a host numpy scalar, no device involved
+            return counts_np.sum().item()  # graftlint: ignore[naked-device-sync]
+    """)
+    assert check_source(
+        src, [NakedDeviceSync()], path="dgraph_tpu/query/x.py"
+    ) == []
+
+
+def test_naked_device_sync_ships_clean_on_tree():
+    from dgraph_tpu.analysis.rules import NakedDeviceSync
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parents[1]
+    findings = run_rules(
+        [str(root / "dgraph_tpu")], [NakedDeviceSync()],
+        repo_root=str(root),
+    )
+    assert findings == [], [f"{f.path}:{f.line}" for f in findings]

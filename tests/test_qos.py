@@ -731,3 +731,97 @@ def test_tenant_shed_and_latency_series_on_server(monkeypatch, srv):
         'dgraph_tenant_query_latency_seconds_count{tenant="series-check"}'
         in text
     )
+
+
+# ------------------------------------------- transport disconnect probes
+
+
+def _tls_pair(tmp_path):
+    """An ssl-wrapped socketpair (server side, client side), or a skip
+    when openssl cannot mint the self-signed cert."""
+    import ssl
+    import subprocess
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    try:
+        r = subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=localhost"],
+            capture_output=True,
+        )
+    except FileNotFoundError:
+        pytest.skip("openssl unavailable")
+    if r.returncode != 0:
+        pytest.skip("openssl unavailable")
+    import socket as _socket
+
+    s1, s2 = _socket.socketpair()
+    sctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    sctx.load_cert_chain(str(cert), str(key))
+    cctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    cctx.check_hostname = False
+    cctx.verify_mode = ssl.CERT_NONE
+    out = {}
+
+    def _server():
+        out["server"] = sctx.wrap_socket(s1, server_side=True)
+
+    t = threading.Thread(target=_server, daemon=True)
+    t.start()
+    client = cctx.wrap_socket(s2, server_hostname="localhost")
+    t.join(timeout=10)
+    assert "server" in out, "TLS handshake did not complete"
+    return out["server"], client
+
+
+def test_disconnect_probe_plain_tcp():
+    """The MSG_PEEK probe on a plain socket: alive while connected,
+    non-consuming on pipelined bytes, GONE on client close."""
+    import socket as _socket
+
+    server, client = _socket.socketpair()
+    try:
+        probe = qos.socket_disconnect_probe(server)
+        assert probe() is False                      # idle, connected
+        client.sendall(b"pipelined")
+        assert probe() is False                      # readable != gone
+        assert server.recv(9) == b"pipelined"        # peek consumed nothing
+        client.close()
+        assert _wait_true(probe)                     # FIN observed: gone
+    finally:
+        server.close()
+
+
+def test_disconnect_probe_tls(tmp_path):
+    """The PR-11 probe was plain-TCP only (SSLSocket rejects recv
+    flags); the TLS flavor peeks the RAW fd and honors the SSL layer's
+    buffered-pending, so a vanished HTTPS client cancels cooperatively
+    too — and a peeked TLS record is never consumed."""
+    server, client = _tls_pair(tmp_path)
+    try:
+        probe = qos.socket_disconnect_probe(server)
+        assert probe() is False                      # idle, connected
+        client.sendall(b"app-bytes")                 # an undrained record
+        assert probe() is False                      # readable != gone
+        assert server.recv(9) == b"app-bytes"        # record fully intact
+        # buffered-pending branch: over-read into the SSL layer's buffer
+        client.sendall(b"xy")
+        assert server.recv(1) == b"x"                # leaves 'y' pending
+        assert server.pending() >= 1
+        assert probe() is False                      # pending bytes: alive
+        assert server.recv(1) == b"y"
+        client.close()
+        assert _wait_true(probe)                     # raw FIN: gone
+    finally:
+        server.close()
+
+
+def _wait_true(probe, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if probe():
+            return True
+        time.sleep(0.02)
+    return False
