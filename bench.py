@@ -340,9 +340,14 @@ def _run_device_dedup(a, frontiers, fcap):
     else:  # ungrouped rows: the slot-map must span every row
         pcap1, pcap2 = fcap, ucap
 
+    # slot-map backend: the sanctioned knob (DGRAPH_TPU_SLOTMAP, PR 16
+    # promotion) or the legacy BENCH_PALLAS=1 the round-5 watch loop
+    # still exports.  Selected OUTSIDE the jitted pipeline: the backend
+    # is baked into the compiled batch program.
     expander = (
         ops.expand_inline_grouped_pallas
-        if os.environ.get("BENCH_PALLAS") == "1" and grouped
+        if grouped
+        and (os.environ.get("BENCH_PALLAS") == "1" or ops.use_slotmap_pallas())
         else ops.expand_inline_grouped
     )
 

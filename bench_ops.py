@@ -13,6 +13,14 @@ sequence (expand, merge, one intersect per predicate, compact), with
 DISPATCH AND COMPILE COUNTS recorded per path — the dispatch ratio is
 the fusion win the headline bench banks.
 
+PR 16 adds the resident-tier A/B: the Pallas segment-gather over an
+HBM-pinned ResidentArena vs expand_csr staged and vs expand_csr paying
+the post-mutation re-staging tax, plus intersect_pallas vs
+intersect_many at k ∈ {2,4,8} (env: BO_RES_NODES/BO_RES_EDGES/
+BO_RES_FRONTIER/BO_RES_SETLEN).  Off-TPU the Pallas arms run in
+interpret mode and emit mode=interpret / perf_claim=false — those rows
+prove the harness and the dispatch discipline, not a speedup.
+
 One JSON line per measurement: {"kernel", "value", "unit", "platform",
 ...extras}.
 
@@ -397,6 +405,123 @@ def bench_triangle(platform, emit):
         })
 
 
+def bench_resident_tier(platform, emit):
+    """Resident Pallas tier vs the staged XLA route (PR 16): the
+    segment-gather over a ResidentArena pinned in HBM against (a)
+    expand_csr on already-staged tensors and (b) expand_csr paying the
+    re-staging tax the resident tier deletes (device_put of the CSR
+    before the hop — what the staged engine does after every mutation);
+    plus the k-way intersect kernel vs intersect_many.  Dispatch and
+    compile counts per arm, warm-path timed.
+
+    Honest per-backend note: off-TPU the Pallas kernels run in
+    INTERPRET mode — correctness speed, not a perf claim (the emitted
+    rows carry mode=interpret so nobody graphs them as one).  The
+    numbers that matter come from this same harness on the TPU arm
+    (Mosaic lowering is the next chip session's measure-first task);
+    the dispatch/compile discipline pins hold on any backend."""
+    import jax
+    import jax.numpy as jnp
+
+    from dgraph_tpu import ops
+    from dgraph_tpu.models.arena import ResidentArena
+    from bench import build_graph
+
+    n_nodes = int(os.environ.get("BO_RES_NODES", 200_000))
+    n_edges = int(os.environ.get("BO_RES_EDGES", 1_500_000))
+    nf = int(os.environ.get("BO_RES_FRONTIER", 2048))
+    interp = platform != "tpu"
+    note = {"mode": "interpret" if interp else "mosaic",
+            "perf_claim": not interp}
+
+    a = build_graph(n_nodes, n_edges)
+    ra = ResidentArena.seed(a.h_offsets, a.host_dst(), a.n_rows, a.n_edges)
+    rng = np.random.default_rng(13)
+    f = np.unique(rng.integers(0, a.n_rows, size=nf)).astype(np.int64)
+    rows = jax.device_put(
+        np.asarray(ops.pad_rows(f, ops.bucket(len(f))), np.int32)
+    )
+    deg = (a.h_offsets[1:] - a.h_offsets[:-1]).astype(np.int64)
+    total = int(deg[f].sum())
+    cap = ops.bucket(total)
+    off32 = np.ascontiguousarray(a.h_offsets, dtype=np.int32)
+    dst32 = np.ascontiguousarray(a.host_dst(), dtype=np.int32)
+    off_dev = jax.device_put(off32)
+    dst_dev = jax.device_put(dst32)
+
+    def timed(counter, fn):
+        r = fn(counter)  # warm: compile + stage constants
+        jax.block_until_ready(r)
+        compiles, n0 = counter.compiles, counter.dispatches
+        t0 = time.time()
+        jax.block_until_ready(fn(counter))
+        return time.time() - t0, compiles, counter.dispatches - n0
+
+    with DispatchCounter() as c:
+        s, compiles, disp = timed(c, lambda c: c.call(
+            ops.gather_pallas_packed, ra.off, ra.dst, rows, cap,
+            interpret=interp,
+        ))
+    emit("gather_resident_pallas", total / s, "edges/s", {
+        **note, "frontier": len(f), "cap": cap,
+        "dispatches_per_hop": disp, "compiles": compiles,
+        "h2d_bytes_per_hop": int(rows.nbytes),
+    })
+
+    with DispatchCounter() as c:
+        s, compiles, disp = timed(c, lambda c: c.call(
+            ops.expand_csr, off_dev, dst_dev, rows, cap
+        ))
+    emit("gather_staged_xla", total / s, "edges/s", {
+        "frontier": len(f), "cap": cap,
+        "dispatches_per_hop": disp, "compiles": compiles,
+        "h2d_bytes_per_hop": int(rows.nbytes),
+    })
+
+    def restaged(counter):
+        # the post-mutation hop of the staged route: the CSR crosses
+        # host->device again before the gather can run
+        o = jax.device_put(off32)
+        d = jax.device_put(dst32)
+        return counter.call(ops.expand_csr, o, d, rows, cap)
+
+    with DispatchCounter() as c:
+        s, compiles, disp = timed(c, restaged)
+    emit("gather_staged_xla_restaged", total / s, "edges/s", {
+        "frontier": len(f), "cap": cap,
+        "dispatches_per_hop": disp, "compiles": compiles,
+        "h2d_bytes_per_hop": int(rows.nbytes + off32.nbytes + dst32.nbytes),
+    })
+
+    # k-way intersect: the kernel vs the XLA merge tree
+    L = int(os.environ.get("BO_RES_SETLEN", 8192))
+    for k in (2, 4, 8):
+        setsk = [
+            np.unique(rng.integers(0, L * 4, size=L * 3 // 4)).astype(
+                np.int32
+            )
+            for _ in range(k)
+        ]
+        mat = jnp.asarray(np.stack([
+            np.asarray(ops.pad_to(s_, L)) for s_ in setsk
+        ]))
+        with DispatchCounter() as c:
+            s, compiles, disp = timed(c, lambda c, m=mat: c.call(
+                ops.intersect_pallas, m, interpret=interp
+            ))
+        emit("intersect_pallas", k * L / s, "elems/s", {
+            **note, "k": k, "L": L,
+            "dispatches": disp, "compiles": compiles,
+        })
+        with DispatchCounter() as c:
+            s, compiles, disp = timed(c, lambda c, m=mat: c.call(
+                ops.intersect_many, m
+            ))
+        emit("intersect_many_xla", k * L / s, "elems/s", {
+            "k": k, "L": L, "dispatches": disp, "compiles": compiles,
+        })
+
+
 def main():
     from bench import ensure_backend
 
@@ -420,6 +545,7 @@ def main():
     bench_batched_vs_per_op(platform, emit)
     bench_kway_intersection(platform, emit)
     bench_triangle(platform, emit)
+    bench_resident_tier(platform, emit)
 
     n_nodes = int(os.environ.get("BO_NODES", 500_000))
     n_edges = int(os.environ.get("BO_EDGES", 4_000_000))

@@ -1088,6 +1088,107 @@ def _b_pallas_slotmap() -> List[ProgramInstance]:
     ]
 
 
+def _slotmap_inst(raw_capc: int) -> ProgramInstance:
+    """The call _ov_slot_map_pallas (ops/sets.py) makes at a raw chunk
+    capacity: capc rounds to the kernel's 128-slot granule."""
+    jnp, np = _jnp()
+    from dgraph_tpu.ops.pallas_slotmap import slotmap_pallas
+
+    cc = ((raw_capc + 127) >> 7) << 7
+    cs = jnp.asarray(np.zeros((1, 128), np.int32))
+    cd = jnp.asarray(np.zeros((1, 128), np.int32))
+    return ProgramInstance(
+        f"Q1xP128xC{cc}", slotmap_pallas, (cs, cd),
+        {"capc": cc, "interpret": True},
+    )
+
+
+def _resident_fixture():
+    """Tiny CSR in the ResidentArena storage layout (models/arena.py):
+    bucketed offsets, dst SENT-padded to _resident_cap's 128-granule +
+    slack-tile contract — what ops/pallas_gather.py walks in HBM."""
+    jnp, np = _jnp()
+    from dgraph_tpu.models.arena import _resident_cap
+    from dgraph_tpu.ops import sets
+
+    degs = np.array([3, 0, 5, 2, 1, 0, 4, 2], np.int64)
+    off = np.zeros(9, np.int32)
+    off[1:] = np.cumsum(degs).astype(np.int32)
+    E = int(off[-1])
+    dst = np.full(_resident_cap(E), sets.SENT, np.int32)
+    dst[:E] = np.arange(100, 100 + E, dtype=np.int32)
+    rows = sets.pad_rows(
+        np.array([0, 2, 3, 6], np.int64), 8
+    ).astype(np.int32)
+    return jnp.asarray(off), jnp.asarray(dst), jnp.asarray(rows)
+
+
+def _gather_inst(raw_cap: int) -> ProgramInstance:
+    jnp, np = _jnp()
+    from dgraph_tpu.ops import sets
+    from dgraph_tpu.ops.pallas_gather import gather_pallas
+
+    off, dst, rows = _resident_fixture()
+    cap = sets.bucket(raw_cap)
+    return ProgramInstance(
+        f"R8xC{cap}", gather_pallas, (off, dst, rows),
+        {"cap": cap, "interpret": True},
+    )
+
+
+def _b_pallas_gather() -> List[ProgramInstance]:
+    from dgraph_tpu.ops.pallas_gather import gather_pallas_packed
+
+    off, dst, rows = _resident_fixture()
+    return [
+        _gather_inst(32),
+        ProgramInstance(
+            "packed_R8xC32", gather_pallas_packed, (off, dst, rows),
+            {"cap": 32, "interpret": True},
+        ),
+    ]
+
+
+def _b_pallas_intersect() -> List[ProgramInstance]:
+    jnp, np = _jnp()
+    from dgraph_tpu.ops import sets
+    from dgraph_tpu.ops.pallas_intersect import intersect_pallas
+
+    m2 = jnp.asarray(np.stack([
+        sets.pad_to(np.arange(0, 20, 2), 64),
+        sets.pad_to(np.arange(0, 30, 3), 64),
+    ]))
+    m4 = jnp.asarray(np.stack([
+        sets.pad_to(np.arange(0, 24, k), 64) for k in (2, 3, 4, 6)
+    ]))
+    return [
+        ProgramInstance("K2xL64", intersect_pallas, (m2,),
+                        {"interpret": True}),
+        ProgramInstance("K4xL64", intersect_pallas, (m4,),
+                        {"interpret": True}),
+    ]
+
+
+def _b_resident_merge() -> List[ProgramInstance]:
+    jnp, np = _jnp()
+    from dgraph_tpu.models import arena as marena
+    from dgraph_tpu.ops import sets
+
+    off, dst, _rows = _resident_fixture()
+    # padded delta pairs exactly as CSRArena._apply_delta_locked packs
+    # them: SENT-filled pads, adds absent from / dels present in the
+    # live buffers (the store-journal contract the merge leans on)
+    ar = jnp.asarray(sets.pad_to(np.array([0, 2], np.int32), 8))
+    ad = jnp.asarray(sets.pad_to(np.array([990, 991], np.int32), 8))
+    dr = jnp.asarray(sets.pad_to(np.array([2], np.int32), 8))
+    dd = jnp.asarray(sets.pad_to(np.array([103], np.int32), 8))
+    return [
+        ProgramInstance(
+            "E17xD8", marena._resident_merge, (off, dst, ar, ad, dr, dd)
+        ),
+    ]
+
+
 _INT = frozenset({"int32", "bool"})
 # searchsorted-bearing kernels: jnp.searchsorted lowers to a log-depth
 # lax.scan whose index carry is uint32 (documented at ops/sets.py
@@ -1124,6 +1225,17 @@ def _mask_probe() -> BucketProbe:
     # mask_lanes buckets the block count: two universes under one
     # bucketed block count must share one program
     return BucketProbe(pairs=((10, 16),), make=_mask_inst)
+
+
+def _gather_probe() -> BucketProbe:
+    # bucket(10) == bucket(12) == 16: two frontier totals in one pow2
+    # capacity bucket must trace ONE resident-gather program
+    return BucketProbe(pairs=((10, 12), (5, 7)), make=_gather_inst)
+
+
+def _slotmap_probe() -> BucketProbe:
+    # 128-slot chunk granule: raw capacities 129 and 250 both pad to 256
+    return BucketProbe(pairs=((129, 250),), make=_slotmap_inst)
 
 
 REGISTRY: Dict[str, ProgramContract] = {
@@ -1335,16 +1447,57 @@ REGISTRY: Dict[str, ProgramContract] = {
             build=_b_pallas_slotmap,
             scan_free=False,   # fori_loop over blocks inside the kernel
             dtypes=_INT,
-            transfer_free=False,  # interpret mode executes via host
-            experimental=True,
-            notes="EXPERIMENTAL: correctness-verified in interpret mode "
-                  "only (tests/test_pallas.py); Mosaic lowering "
-                  "unverified since the round-4 tunnel outage and "
-                  "BENCH_r05 shows it never became load-bearing "
-                  "(pallas_slotmap: false).  Registered so the kernel "
-                  "still carries fingerprint + callback + dtype "
-                  "coverage; promote to a full contract when a chip "
-                  "session qualifies the lowering.",
+            bucket_probe=_slotmap_probe(),
+            notes="PROMOTED (PR 16): wired into the grouped-expansion "
+                  "path behind DGRAPH_TPU_SLOTMAP (ops/sets.py "
+                  "expand_inline_grouped_auto), full checks — transfer, "
+                  "cost, bucket probe — in interpret mode; Mosaic "
+                  "lowering itself is still the next chip session's "
+                  "measure-first task (which is why auto mode stays "
+                  "TPU-backend-gated).",
+        ),
+        ProgramContract(
+            name="pallas.gather",
+            covers=(
+                f"{_OPS}/pallas_gather.py::gather_pallas",
+                f"{_OPS}/pallas_gather.py::gather_pallas_packed",
+            ),
+            build=_b_pallas_gather,
+            scan_free=False,   # the per-row DMA loop is a fori_loop
+            # int16: interpret mode models the kernel's DMA semaphores
+            # (pltpu.SemaphoreType.DMA scratch) as int16 avals — kernel
+            # data stays strictly int32
+            dtypes=_INT | {"int16"},
+            bucket_probe=_gather_probe(),
+            notes="device-resident posting gather (PR 16, the "
+                  "route:resident walk primitive): double-buffered "
+                  "HBM->VMEM span copies over ResidentArena's pinned "
+                  "CSR, byte-identical to expand_csr; checked in "
+                  "interpret mode (Mosaic lowering is the next chip "
+                  "session's A/B).",
+        ),
+        ProgramContract(
+            name="pallas.intersect",
+            covers=(f"{_OPS}/pallas_intersect.py::intersect_pallas",),
+            build=_b_pallas_intersect,
+            scan_free=False,   # interpret-mode grid loop
+            dtypes=_INT,
+            notes="k-way (k<=8) sorted-set intersect over the stored "
+                  "layout (PR 16, EmptyHeaded-style probe + VPU "
+                  "membership tiles), byte-identical to intersect_many; "
+                  "checked in interpret mode.",
+        ),
+        ProgramContract(
+            name="resident.merge",
+            covers=("dgraph_tpu/models/arena.py::_resident_merge",),
+            build=_b_resident_merge,
+            scan_free=False,
+            dtypes=_INT_SS,
+            notes="on-device delta application for resident arenas "
+                  "(PR 16): lexsort merge of live edges + netted journal "
+                  "pairs into the NEXT epoch's (offsets, dst) — the "
+                  "device twin of CSRArena._apply_delta_locked.  Only "
+                  "the padded delta pairs ever cross h2d." + _SS_NOTE,
         ),
     )
 }

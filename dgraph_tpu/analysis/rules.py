@@ -68,6 +68,13 @@ on a noisy 2-core CPU host:
   ``<token>.check()``) inside the loop, or pragma the site with the
   WHY.
 
+- ``naked-resident-transfer``: a ``jax.device_put`` / ``np.asarray`` /
+  ``jnp.asarray`` on a resident arena's device buffers outside
+  ``models/arena.py`` — the resident tier's contract (PR 16) is that
+  the pinned CSR never re-crosses the host/device boundary after
+  seeding; ``ResidentArena.seed``/``apply_delta`` are the only
+  sanctioned (and ledger-charged) stagings.
+
 Suppress a deliberate site with ``# graftlint: ignore[rule-id]`` on the
 line (or the line above).  docs/analysis.md has the full catalog and
 the how-to-add-a-rule walkthrough.
@@ -1216,6 +1223,74 @@ class UnregisteredProgramFactory(Rule):
             self._visit(child, stack, names, out, seen)
 
 
+# -- rule: naked-resident-transfer --------------------------------------------
+
+def _residentish(node: ast.AST) -> bool:
+    """Does this expression reach into a resident arena's device
+    buffers?  Matches any name/attribute mentioning ``resident`` (e.g.
+    ``arena.resident()``, ``self._resident``) and the ``off``/``dst``
+    lanes of a receiver conventionally named for one (``ra``/``nra``)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute):
+            if "resident" in sub.attr:
+                return True
+            if sub.attr in ("off", "dst"):
+                base = sub.value
+                if isinstance(base, ast.Name) and base.id in (
+                    "ra", "nra", "resident"
+                ):
+                    return True
+                if (
+                    isinstance(base, ast.Call)
+                    and isinstance(base.func, ast.Attribute)
+                    and base.func.attr == "resident"
+                ):
+                    return True
+        elif isinstance(sub, ast.Name) and "resident" in sub.id:
+            return True
+    return False
+
+
+class NakedResidentTransfer(Rule):
+    id = "naked-resident-transfer"
+    doc = (
+        "jax.device_put / np.asarray / jnp.asarray on a resident "
+        "arena's device buffers outside models/arena.py — the resident "
+        "tier's whole contract is that the CSR never re-crosses the "
+        "host/device boundary after seeding (ledger h2d/d2h = 0 for a "
+        "warm hop); staging or fetching those buffers elsewhere "
+        "reintroduces the transfer tax the tier deletes, uncharged"
+    )
+
+    # models/arena.py is the sanctioned home of every resident-buffer
+    # staging (ResidentArena.seed / apply_delta, both ledger-charged)
+    _HOME = "models/arena.py"
+    _XFER = (
+        "jax.device_put", "device_put",
+        "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+        "jnp.asarray", "jnp.array",
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.path.replace("\\", "/").endswith(self._HOME):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if _dotted(node.func) not in self._XFER:
+                continue
+            if any(_residentish(a) for a in node.args):
+                yield ctx.finding(
+                    self.id, node,
+                    "transfer primitive on a resident arena buffer: the "
+                    "pinned CSR must never re-cross the boundary outside "
+                    "models/arena.py (seed/apply_delta, ledger-charged) "
+                    "— expand via ResidentArena.expand_packed and fetch "
+                    "only the packed result, or pragma the site with the "
+                    "WHY",
+                )
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     HostSyncInJit(),
     RecompileHazard(),
@@ -1230,4 +1305,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     UncheckedHopLoop(),
     UnregisteredMetric(),
     UnregisteredProgramFactory(),
+    NakedResidentTransfer(),
 )

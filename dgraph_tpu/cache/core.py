@@ -220,6 +220,32 @@ class VersionedLFUCache:
                 repaired += 1
         return repaired, dropped
 
+    def rekey_where(
+        self,
+        pred: Callable[[object], bool],
+        keyfn: Callable[[object], object],
+    ) -> int:
+        """Move every entry whose KEY satisfies ``pred`` to
+        ``keyfn(key)``, preserving value/version/heat/age (the moved
+        entry IS the same logical entry — used by the arena-epoch flip,
+        which changes WHERE a hop result is keyed, not whether it is
+        still correct).  A collision with an existing destination key
+        keeps the moved entry (the mover has strictly fresher context).
+        Returns how many entries moved."""
+        moved = 0
+        with self._lock:
+            for k in [k for k in self._m if pred(k)]:
+                nk = keyfn(k)
+                if nk == k:
+                    continue
+                e = self._m.pop(k)
+                old = self._m.get(nk)
+                if old is not None:
+                    self._bytes -= old.nbytes
+                self._m[nk] = e
+                moved += 1
+        return moved
+
     def drop_where(self, pred: Callable[[object], bool]) -> int:
         """Remove every entry whose KEY satisfies ``pred`` (explicit
         invalidation — e.g. tier 1 on arena eviction).  Returns count."""

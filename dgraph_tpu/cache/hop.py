@@ -115,8 +115,22 @@ class HopCache:
     def key_for(self, arena, attr: str, reverse: bool, src: np.ndarray):
         """Precompute the entry key — the digest is the expensive part
         (big frontiers hash megabytes), and a miss needs the SAME key
-        for its fill put, so the expander computes it once per call."""
-        return (id(arena), attr, bool(reverse), frontier_digest(src))
+        for its fill put, so the expander computes it once per call.
+
+        The arena EPOCH (PR 16: bumped once per applied delta,
+        models/arena.py) rides at index 3: an entry filled before a
+        delta can never match a probe after it through key equality
+        alone — ``id()`` recycling protection (``drop_arena``) and
+        version staleness both remain, but the epoch closes the window
+        where an id-keyed entry could outlive the SNAPSHOT it was
+        computed against (the delta-driven twin of the PR 15
+        eviction-vs-in-flight race).  Repaired entries are re-keyed to
+        the new epoch (``repair_pred``); unrepaired stale-epoch entries
+        are dropped eagerly (``drop_stale_epoch``)."""
+        return (
+            id(arena), attr, bool(reverse),
+            getattr(arena, "epoch", 0), frontier_digest(src),
+        )
 
     def get(
         self, arena, attr: str, reverse: bool, src: np.ndarray, version: int,
@@ -182,6 +196,8 @@ class HopCache:
         dels: np.ndarray,
         old_version: int,
         new_version: int,
+        old_epoch: int = 0,
+        new_epoch: int = 0,
     ):
         """Apply a predicate's edge deltas to every cached entry for
         ``(arena_id, attr, reverse)`` recorded at ``old_version``,
@@ -189,8 +205,24 @@ class HopCache:
         cannot repair (or that sit at any other version) drop.  Called
         from ``ArenaManager._try_apply_delta`` after the arena's own
         host mirrors were updated, under the repair cost gate
-        (query/planner.py).  Returns (repaired, dropped)."""
+        (query/planner.py).  Returns (repaired, dropped).
+
+        ``old_epoch → new_epoch``: the delta that drives this repair
+        also bumped the arena's epoch (a key element since PR 16), so
+        entries at the pre-delta epoch are MOVED to the post-delta key
+        first — otherwise the value repair would strand them at a key no
+        probe can ever form again.  The defaults (0, 0) are a no-op for
+        callers predating the epoch (and for direct test drivers)."""
         from dgraph_tpu.ivm.repair import repair_hop_entry
+
+        def match(k):
+            return k[0] == arena_id and k[1] == attr and k[2] == bool(reverse)
+
+        if new_epoch != old_epoch:
+            self._c.rekey_where(
+                lambda k: match(k) and k[3] == old_epoch,
+                lambda k: k[:3] + (new_epoch,) + k[4:],
+            )
 
         def fix(value):
             out, seg_ptr, frontier = value
@@ -205,8 +237,7 @@ class HopCache:
             return (out2, seg2, frontier), nbytes
 
         res = self._c.repair_where(
-            lambda k: k[0] == arena_id and k[1] == attr
-            and k[2] == bool(reverse),
+            match,
             old_version,
             new_version,
             fix,
@@ -215,6 +246,18 @@ class HopCache:
         return res
 
     # -- invalidation --------------------------------------------------------
+
+    def drop_stale_epoch(self, arena_id: int, epoch: int) -> int:
+        """Drop every entry for ``arena_id`` NOT keyed at ``epoch`` —
+        the post-delta sweep (``ArenaManager._try_apply_delta``): any
+        entry the repair pass did not carry forward describes a snapshot
+        that no longer exists, and must not squat in the budget waiting
+        for its generation sweep."""
+        n = self._c.drop_where(
+            lambda k: k[0] == arena_id and k[3] != epoch
+        )
+        QCACHE_HOP_BYTES.set(self._c.occupancy_bytes)
+        return n
 
     def drop_arena(self, arena_id: int) -> int:
         """Explicit drop when the ArenaManager evicts (or rebuilds) an

@@ -28,6 +28,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from dgraph_tpu import ops
@@ -181,6 +182,11 @@ class CSRArena:
             # MXU join tier (ops/spgemm.py): densified adjacency blocks
             # ride the same HBM budget/eviction as every other layout
             n += self._tiles.device_bytes()
+        if self._resident is not None:
+            # resident Pallas tier: live epoch buffers AND the shadow
+            # (previous epoch, pinned through the flip window) — each
+            # counted exactly once (ResidentArena.device_bytes)
+            n += self._resident.device_bytes()
         return n
 
     _inline: Optional[tuple] = None  # lazy (metap, ov_chunks)
@@ -384,6 +390,32 @@ class CSRArena:
         hit = self.h_src[pos] == uids
         return np.where(hit, pos, -1)
 
+    # -- device-resident tier (PR 16: ops/pallas_gather.py) -----------------
+
+    _resident: Optional[object] = None  # lazy ResidentArena
+    epoch: int = 0  # bumped once per applied delta; hop-cache key element
+    #                 (cache/hop.py key_for index 3): a pre-delta entry
+    #                 can never match a post-delta probe by key equality
+
+    def resident(self) -> "ResidentArena":
+        """Device-pinned CSR view for the Pallas gather tier, built
+        lazily from the host mirrors and kept fresh by ``apply_delta``
+        (device-side merge, or a reseed on structural change) — never by
+        per-query re-staging: after the first seed, mutations cross the
+        host→device boundary as delta pairs only.  Counted in
+        ``device_bytes()``, so the ArenaManager HBM budget/LRU governs
+        its residency like every other derived layout."""
+        ra = self._resident
+        if ra is not None:
+            return ra
+        with _BUILD_LOCK:
+            if self._resident is None:
+                self._resident = ResidentArena.seed(
+                    self.h_offsets, self.host_dst(), self.n_rows,
+                    self.n_edges,
+                )
+            return self._resident
+
     # -- incremental refresh (gentle-commit analog) -------------------------
 
     _device_stale: bool = False
@@ -415,6 +447,8 @@ class CSRArena:
         # rows' PRE-delta degrees so the log2 buckets can be adjusted
         # instead of dropped — the planner's skew inputs (joinplan's
         # heavy-tail pad) otherwise cold-start on every point write
+        pre_rows = self.n_rows  # resident reseed probe: new source rows
+        #                         shift every row index (see tail below)
         hist = getattr(self, "_deg_hist", None)
         touched = None
         if hist is not None:
@@ -505,6 +539,45 @@ class CSRArena:
                     if led is not None:
                         led.repairs += 1
             self._tiles = repaired
+        if len(adds) or len(dels):
+            # arena EPOCH flip: probes formed after this point can never
+            # match entries filled before it (cache/hop.py key_for)
+            self.epoch += 1
+            ra = self._resident
+            if ra is not None:
+                if self.n_rows != pre_rows or self.n_edges + 128 > ra.ecap:
+                    # structural change (new source rows renumber every
+                    # row) or the gather kernel's 128-lane slack tile
+                    # would be breached: fresh upload becomes the next
+                    # epoch, old buffers become the shadow (honest h2d
+                    # charge inside seed)
+                    nra = ResidentArena.seed(
+                        self.h_offsets, self._h_dst, self.n_rows,
+                        self.n_edges,
+                    )
+                    nra._prev = (ra.off, ra.dst)
+                    self._resident = nra
+                else:
+                    # device-side delta application: only the (row, dst)
+                    # delta pairs cross host→device; the merge program
+                    # produces the next epoch's buffers off the current
+                    # ones, and the reference flip inside apply_delta is
+                    # the atomic epoch swap
+                    def _pack(arr):
+                        rows = np.searchsorted(self.h_src, arr[:, 0])
+                        b = ops.bucket(max(1, len(arr)))
+                        return (
+                            jnp.asarray(
+                                ops.pad_to(rows.astype(np.int32), b)
+                            ),
+                            jnp.asarray(
+                                ops.pad_to(arr[:, 1].astype(np.int32), b)
+                            ),
+                        )
+
+                    ar, ad = _pack(adds)
+                    dr, dd = _pack(dels)
+                    ra.apply_delta(ar, ad, dr, dd, self.n_edges)
         self._device_stale = True
 
     def _degrees_of_uids(self, uids: np.ndarray) -> np.ndarray:
@@ -578,6 +651,147 @@ def _ivm_repair_gate(n_delta: int, entry_edges: float) -> bool:
     if dec is not None:
         planner.record(None, dec)
     return ok
+
+
+def _resident_cap(n_edges: int) -> int:
+    """Capacity of the resident dst buffer: live edges plus growth
+    headroom (~1/8th, floor 1024) so point-mutation bursts merge on
+    device instead of reseeding, rounded to the gather kernel's 128-lane
+    granule PLUS one slack tile — the layout contract of
+    ops/pallas_gather.py (a row's tail tile may read up to 127 lanes
+    past its span without bounds checks)."""
+    head = max(n_edges // 8, 1024)
+    return ((n_edges + head + 127) // 128) * 128 + 128
+
+
+@jax.jit
+def _resident_merge(off, dst, add_r, add_d, del_r, del_d):
+    """Jitted segment-scatter: produce the NEXT epoch's (offsets, dst)
+    from the live buffers plus padded (row, dst) delta pairs — the
+    device-side twin of ``CSRArena._apply_delta_locked``'s host merge,
+    with sorts in place of np.insert/np.delete (no int64 composite keys:
+    x64 is disabled, so the (row, dst, tag) triple rides ``lexsort``).
+
+    Correctness leans on the store-journal contract the host merge
+    already relies on: adds must not already exist, dels must exist, and
+    ``_try_apply_delta`` nets the journal so no key is both — hence a
+    del's (row, dst) twin is exactly one live edge, and with ``tag`` as
+    the last sort key it lands IMMEDIATELY after that twin.  Delta pads
+    carry (SENT, SENT) and sort past every live row.  Registered as
+    "resident.merge" in the device-program contract registry."""
+    sb1 = off.shape[0]              # Sb + 1 (static)
+    big = jnp.int32(sb1)            # > any live row index
+    ecap = dst.shape[0]
+    idx = jnp.arange(ecap, dtype=jnp.int32)
+    # row of each packed edge slot; off[-1] == E by the pad contract
+    er = jnp.searchsorted(off[1:], idx, side="right").astype(jnp.int32)
+    live = idx < off[-1]
+    rows0 = jnp.where(live, er, big)
+    dst0 = jnp.where(live, dst, SENT)
+    rows_c = jnp.concatenate([rows0, add_r, del_r])
+    dst_c = jnp.concatenate([dst0, add_d, del_d])
+    tag = jnp.concatenate([
+        jnp.zeros(ecap + add_r.shape[0], jnp.int32),
+        jnp.ones(del_r.shape[0], jnp.int32),
+    ])
+    o = jnp.lexsort((tag, dst_c, rows_c))
+    r_s, d_s, t_s = rows_c[o], dst_c[o], tag[o]
+    nxt_del = jnp.concatenate([t_s[1:] == 1, jnp.zeros(1, bool)])
+    same = jnp.concatenate([
+        (r_s[1:] == r_s[:-1]) & (d_s[1:] == d_s[:-1]),
+        jnp.zeros(1, bool),
+    ])
+    remove = (t_s == 1) | (nxt_del & same)
+    r_f = jnp.where(remove, big, r_s)
+    d_f = jnp.where(remove, SENT, d_s)
+    o2 = jnp.lexsort((d_f, r_f))
+    r_f = r_f[o2][:ecap]
+    d_f = d_f[o2][:ecap]
+    # new offsets by rank: matches _csr_from_arrays pad semantics
+    # (off[r] == E' for every padding row r > S, dst SENT-padded)
+    new_off = jnp.searchsorted(
+        r_f, jnp.arange(sb1, dtype=jnp.int32), side="left"
+    ).astype(jnp.int32)
+    return new_off, d_f
+
+
+class ResidentArena:
+    """Device-pinned CSR (offsets + packed dst) for the Pallas gather
+    tier: the buffers ``ops.gather_pallas`` walks directly in HBM — the
+    "store format IS the kernel format" endpoint (PAPERS.md RedisGraph/
+    GraphBLAS line).  Unlike ``CSRArena.ensure_device`` — which re-stages
+    the full CSR triple after every mutation — a resident arena absorbs
+    deltas ON DEVICE (``_resident_merge``) under double-buffered epochs:
+    the merge produces the next epoch's buffers, the reference flip in
+    ``apply_delta`` is the atomic swap, and the previous epoch's buffers
+    stay pinned as the shadow so in-flight expansions holding them read
+    a consistent snapshot.  ``device_bytes()`` counts live AND shadow,
+    each exactly once — the constant-across-flips total the ArenaManager
+    budget accountant sees (no transient double-count in the flip
+    window)."""
+
+    def __init__(self, off: jnp.ndarray, dst: jnp.ndarray, n_edges: int):
+        self.off = off              # int32[Sb+1], live epoch
+        self.dst = dst              # int32[Ecap], SENT slack-padded
+        self.n_edges = int(n_edges)
+        self._prev: Optional[tuple] = None  # shadow: previous epoch
+
+    @property
+    def ecap(self) -> int:
+        return int(self.dst.shape[0])
+
+    @classmethod
+    def seed(cls, h_offsets, h_dst, n_rows: int, n_edges: int):
+        """Initial (or reseed) upload from the host mirrors — the ONE
+        sanctioned full staging of a resident arena, charged h2d."""
+        Sb = ops.bucket(max(1, n_rows))
+        E = int(n_edges)
+        off = np.full(Sb + 1, E, dtype=np.int32)
+        off[: n_rows + 1] = h_offsets.astype(np.int32)
+        dstp = np.full(_resident_cap(E), SENT, dtype=np.int32)
+        if E:
+            dstp[:E] = np.asarray(h_dst[:E], dtype=np.int32)
+        ra = cls(jnp.asarray(off), jnp.asarray(dstp), E)
+        led = _ledger.current()
+        if led is not None:
+            led.bytes_h2d += int(ra.off.nbytes + ra.dst.nbytes)
+        return ra
+
+    def apply_delta(self, add_r, add_d, del_r, del_d, n_edges: int) -> None:
+        """Merge padded device delta pairs into the NEXT epoch's buffers
+        and flip.  Only the delta pairs cross the boundary (charged h2d);
+        the merge inputs and outputs never leave the device."""
+        new_off, new_dst = _resident_merge(
+            self.off, self.dst, add_r, add_d, del_r, del_d
+        )
+        led = _ledger.current()
+        if led is not None:
+            led.bytes_h2d += int(
+                add_r.nbytes + add_d.nbytes + del_r.nbytes + del_d.nbytes
+            )
+        # the flip: previous epoch's buffers become the shadow (readers
+        # holding them stay consistent; the NEXT flip releases them)
+        self._prev = (self.off, self.dst)
+        self.off = new_off
+        self.dst = new_dst
+        self.n_edges = int(n_edges)
+
+    def expand_packed(
+        self, rows: jnp.ndarray, cap: int, interpret: bool = False
+    ) -> jnp.ndarray:
+        """Packed frontier expansion against the LIVE epoch buffers:
+        device-in, device-out, concat([out, seg]) like the engine's
+        ``_packed_expand_csr`` — the transfer-free hop core (the engine
+        fetches the result and charges the ledger itself)."""
+        return ops.gather_pallas_packed(
+            self.off, self.dst, rows, cap, interpret=interpret
+        )
+
+    def device_bytes(self) -> int:
+        n = int(self.off.nbytes + self.dst.nbytes)
+        if self._prev is not None:
+            n += int(sum(t.nbytes for t in self._prev))
+        return n
 
 
 def _build_csr(rows_to_dsts: Dict[int, np.ndarray]) -> CSRArena:
@@ -1100,6 +1314,14 @@ class ArenaManager:
                 n_delta, max(1.0, a.avg_degree) * 32.0
             )),
         )
+        # post-delta epoch sweep (the delta-driven twin of the PR 15
+        # eviction race): entries the repair pass did not carry to the
+        # new epoch describe a snapshot that no longer exists — drop
+        # them now rather than letting them squat until their sweep
+        if self.hop_cache is not None and n_delta > 0:
+            self.hop_cache.drop_stale_epoch(id(a), a.epoch)
+            if r is not None:
+                self.hop_cache.drop_stale_epoch(id(r), r.epoch)
         return True
 
     def _repair_hop_entries(
@@ -1137,8 +1359,13 @@ class ArenaManager:
             ):
                 if arena is None:
                     continue
+                # the delta that drives this repair bumped the arena
+                # epoch exactly once (zero-delta re-keys bump nothing)
+                ne = getattr(arena, "epoch", 0)
+                oe = ne - 1 if (len(adds) or len(dels)) else ne
                 rep, drop = self.hop_cache.repair_pred(
-                    id(arena), pred, rev, ad, dl, base, new_v
+                    id(arena), pred, rev, ad, dl, base, new_v,
+                    old_epoch=oe, new_epoch=ne,
                 )
                 repaired += rep
                 dropped += drop

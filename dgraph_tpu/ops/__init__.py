@@ -18,6 +18,8 @@ from dgraph_tpu.ops.sets import (  # noqa: F401
     expand_inline_seg,
     expand_inline_grouped,
     expand_inline_grouped_pallas,
+    expand_inline_grouped_auto,
+    use_slotmap_pallas,
     skey_encode,
     skey_uid,
     GROUP_BIT,
@@ -41,6 +43,15 @@ from dgraph_tpu.ops.sets import (  # noqa: F401
     unique_dense,
     unique_rows_sorted,
     frontier_rows,
+)
+from dgraph_tpu.ops.pallas_gather import (  # noqa: F401
+    gather_pallas,
+    gather_pallas_packed,
+    gather_reference,
+)
+from dgraph_tpu.ops.pallas_intersect import (  # noqa: F401
+    intersect_pallas,
+    intersect_reference,
 )
 from dgraph_tpu.ops.order import (  # noqa: F401
     gather_ranks,

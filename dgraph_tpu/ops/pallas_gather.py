@@ -1,0 +1,222 @@
+"""Pallas segment-gather kernel: frontier expansion over a RESIDENT CSR.
+
+The XLA posting gather (ops/sets.py expand_csr) re-derives slot ownership
+per hop — a scatter plus two O(cap) scan passes — and, worse, runs over
+arena tensors the engine re-stages host→device after every mutation
+(models/arena.py ensure_device: the staging tax the planner exists to
+price).  This kernel is the device-resident tier's walk primitive
+(docs/ROOFLINE.md "Device-resident data plane"): the frontier's posting
+spans are DMA-copied HBM→VMEM in double-buffered 128-lane tiles and
+written straight into the output segment — no owner scatter, no
+prefix-sum over the output, no staged copy of the arena.
+
+Layout contract ("the store format IS the kernel format"):
+
+- ``dst`` carries >= 127 lanes of slack past the live edge count, so a
+  row's tail tile may read past its span without bounds checks (it reads
+  the NEXT row's edges or SENT slack; both are overwritten or masked —
+  see below).  ResidentArena (models/arena.py) stores exactly this
+  padding; round_up(E, 128) + 128 satisfies it for every E.
+- Rows write their spans IN ORDER and TPU grid steps run sequentially,
+  so row j's tail-tile garbage (the lanes past deg_j) is overwritten by
+  row j+1's leading tile; only the garbage past the LAST productive
+  row's span survives the kernel, and the epilog masks everything past
+  ``total`` (SENT / -1), making the output byte-identical to
+  ``expand_csr`` on the same inputs.
+
+Status: correctness-verified in Pallas interpret mode on CPU
+(tests/test_pallas.py, the `pallas-interpret` CI tier).  Mosaic lowering
+is unverified until the next real-chip session — the dynamic-trip-count
+DMA loop and 1-D (128,) copies here are the constructs it may want
+reshaped; the TPU A/B measurement is wired in bench_ops.py and the
+kernel is registered in the device-program contract registry
+(analysis/programs.py "pallas.gather").
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.ops.sets import SENT
+
+TILE = 128  # VMEM copy granule (one VPU lane row of int32)
+
+
+def _kernel(start_ref, deg_ref, sstart_ref, dst_hbm, out_hbm, seg_hbm,
+            vbuf, sbuf, in_sem, out_sem, seg_sem):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    capk = out_hbm.shape[0]
+    rid = pl.program_id(0)
+    deg = deg_ref[0]
+    start = start_ref[0]
+    ss = sstart_ref[0]
+    nt = pl.cdiv(deg, TILE)
+
+    # the seg tile is one constant per row: fill it once, reuse per tile
+    sbuf[0:1] = jnp.full((1, TILE), rid, jnp.int32)
+
+    def _in_copy(t, slot):
+        return pltpu.make_async_copy(
+            dst_hbm.at[pl.ds(ss + t * TILE, TILE)],
+            vbuf.at[slot],
+            in_sem.at[slot],
+        )
+
+    @pl.when(nt > 0)
+    def _warmup():
+        _in_copy(0, 0).start()
+
+    def body(t, _):
+        slot = jax.lax.rem(t, 2)
+
+        @pl.when(t + 1 < nt)
+        def _prefetch():
+            _in_copy(t + 1, jax.lax.rem(t + 1, 2)).start()
+
+        _in_copy(t, slot).wait()
+        wp = start + t * TILE
+        # tiles past the static output capacity are dropped — the same
+        # silent truncation expand_csr applies when the caller's cap is
+        # too small (the epilog's total still reports the true count)
+        @pl.when(wp + TILE <= capk)
+        def _writeback():
+            oc = pltpu.make_async_copy(
+                vbuf.at[slot], out_hbm.at[pl.ds(wp, TILE)], out_sem
+            )
+            oc.start()
+            sc = pltpu.make_async_copy(
+                sbuf.at[0], seg_hbm.at[pl.ds(wp, TILE)], seg_sem
+            )
+            sc.start()
+            # synchronous writeback: the NEXT row's leading tile must
+            # land after this row's tail tile (the overlap-overwrite
+            # contract above), and grid-step ordering only sequences the
+            # programs, not their in-flight DMAs
+            oc.wait()
+            sc.wait()
+
+        return 0
+
+    jax.lax.fori_loop(0, nt, body, 0)
+
+
+@partial(jax.jit, static_argnames=("cap", "interpret"))
+def gather_pallas(
+    offsets: jnp.ndarray,
+    dst: jnp.ndarray,
+    rows: jnp.ndarray,
+    cap: int,
+    interpret: bool = False,
+):
+    """Resident-CSR frontier expansion, byte-identical to
+    ``ops.sets.expand_csr(offsets, dst, rows, cap)``.
+
+    Args:
+      offsets: int32[Sb+1] CSR row offsets (padding rows degree 0).
+      dst:     int32[Ek] packed target uids with Ek % 128 == 0 and at
+               least 127 SENT lanes of slack past the live edges (the
+               ResidentArena storage contract; see module docstring).
+      rows:    int32[B] arena row indices, negative = skip.
+      cap:     static output capacity (bucketed total degree).
+
+    Returns (out int32[cap], seg int32[cap], total int32) exactly as
+    expand_csr: out grouped by source (ascending within a group),
+    SENT-padded; seg = producing index into ``rows``, -1-padded.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nrows = rows.shape[0]
+    assert nrows >= 1
+    assert dst.shape[0] % TILE == 0, "resident dst must be 128-lane padded"
+    if dst.shape[0] == 0:  # edgeless arena (static shortcut, as expand_csr)
+        return (
+            jnp.full((cap,), SENT, dtype=jnp.int32),
+            jnp.full((cap,), -1, dtype=jnp.int32),
+            jnp.int32(0),
+        )
+    # XLA prolog: the same O(B) frontier math as expand_csr's head — the
+    # O(cap) owner scatter/scan chain is what the kernel deletes
+    valid = rows >= 0
+    r = jnp.where(valid, rows, 0)
+    deg = jnp.where(valid, offsets[r + 1] - offsets[r], 0)
+    cum = jnp.cumsum(deg)
+    total = cum[-1]
+    start = (cum - deg).astype(jnp.int32)
+    sstart = jnp.where(valid, offsets[r], 0).astype(jnp.int32)
+    degi = deg.astype(jnp.int32)
+
+    # kernel-side capacity: room for every tile overlapping [0, cap)
+    # plus one full tail tile, so in-bounds DMA needs no lane masks
+    capk = ((cap + TILE - 1) // TILE) * TILE + TILE
+    out_k, seg_k = pl.pallas_call(
+        _kernel,
+        grid=(nrows,),
+        in_specs=[
+            pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # dst stays in HBM
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((capk,), jnp.int32),
+            jax.ShapeDtypeStruct((capk,), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, TILE), jnp.int32),
+            pltpu.VMEM((1, TILE), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(start, degi, sstart, dst)
+    i = jnp.arange(cap, dtype=jnp.int32)
+    ok = i < total
+    out = jnp.where(ok, out_k[:cap], SENT)
+    seg = jnp.where(ok, seg_k[:cap], -1)
+    return out, seg, total.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cap", "interpret"))
+def gather_pallas_packed(
+    offsets: jnp.ndarray,
+    dst: jnp.ndarray,
+    rows: jnp.ndarray,
+    cap: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """``gather_pallas`` with the engine's packed transfer layout:
+    ``concat([out, seg])`` (int32[2*cap]) so the resident hop fetches one
+    buffer, exactly like the staged ``_packed_expand_csr`` program
+    (query/engine.py).  The caller already knows ``total`` host-side."""
+    out, seg, _ = gather_pallas(offsets, dst, rows, cap, interpret=interpret)
+    return jnp.concatenate([out, seg])
+
+
+def gather_reference(h_offsets, h_dst, rows, cap):
+    """Pure-numpy oracle of the same contract (for tests): expand each
+    non-negative row's span in order, SENT/-1 pad, silent truncation."""
+    import numpy as np
+
+    out = np.full(cap, SENT, dtype=np.int32)
+    seg = np.full(cap, -1, dtype=np.int32)
+    pos = 0
+    for j, row in enumerate(np.asarray(rows).tolist()):
+        if row < 0:
+            continue
+        for e in range(int(h_offsets[row]), int(h_offsets[row + 1])):
+            if pos < cap:
+                out[pos] = h_dst[e]
+                seg[pos] = j
+            pos += 1
+    return out, seg, pos

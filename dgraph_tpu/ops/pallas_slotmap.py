@@ -17,17 +17,19 @@ Pallas fused segmented-scan kernel"):
 Per 128-slot block the kernel takes the prefix offset plus a <=128-row
 window max — a [128 x 128] VPU tile — instead of global scans/scatters.
 
-Status: correctness-verified in Pallas interpret mode on CPU
-(tests/test_pallas.py).  NOT yet wired into the bench or engine: Mosaic
-lowering is unverified (the round-4 tunnel outage blocked real-chip
-compilation — interpret mode skips Mosaic, and the 1-D scratch reshape /
-dynamic slices here are constructs it may want reshaped), so integration
-is a measure-first task for the next chip session: compile, A/B against
-the XLA slot-map, then gate into expand_inline_grouped.  The kernel is
-registered EXPERIMENTAL in the device-program contract registry
-(analysis/programs.py "pallas.slotmap"): callback/dtype invariants and
-a golden fingerprint are enforced now, and promotion to a full contract
-(transfer/cost checks, a bucket probe) is part of that chip session.
+Status: PROMOTED (PR 16).  Wired into the grouped-expansion path behind
+the DGRAPH_TPU_SLOTMAP knob (ops/sets.py expand_inline_grouped_auto /
+use_slotmap_pallas; bench.py's device-dedup pipeline selects it, and the
+legacy BENCH_PALLAS=1 override still works): '1' auto enables the kernel
+on the TPU backend only, 'force' runs it anywhere under the interpreter
+— the mode the parity property tests pin (tests/test_pallas.py, vs both
+the XLA slot-map and slotmap_reference).  The contract registry entry
+(analysis/programs.py "pallas.slotmap") is FULL: golden fingerprint,
+callback/dtype/transfer audits, a cost entry and a bucket probe.  Mosaic
+lowering itself remains a measure-first task for the next chip session
+(interpret mode skips Mosaic; the 1-D scratch reshape / dynamic slices
+here are constructs it may want reshaped) — which is why auto mode stays
+backend-gated rather than unconditional.
 """
 
 from __future__ import annotations

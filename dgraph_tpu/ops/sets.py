@@ -592,6 +592,44 @@ def expand_inline_grouped_pallas(
     return inline, ov, total
 
 
+def use_slotmap_pallas() -> bool:
+    """Should grouped expansions route their slot-map through the Pallas
+    kernel?  DGRAPH_TPU_SLOTMAP (utils/planconfig.py): '0' never, '1'
+    auto (TPU backend only — Mosaic is where the kernel pays off; the
+    interpreter is correctness-speed), 'force' any backend (interpret
+    mode off-TPU, the parity-test mode)."""
+    from dgraph_tpu.utils import planconfig
+
+    mode = planconfig.slotmap_pallas()
+    if mode == "0":
+        return False
+    if mode == "force":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def expand_inline_grouped_auto(
+    metap: jnp.ndarray,
+    ov_chunks: jnp.ndarray,
+    rows: jnp.ndarray,
+    capc: int,
+    pcap: int,
+):
+    """Knob-dispatched grouped expansion: the seam grouped-frontier
+    consumers (bench.py's device-dedup pipeline) call so the slot-map
+    backend — XLA scan/scatter chain vs the Pallas kernel — is an
+    operator decision, not a code fork.  Reads the knob at call/trace
+    time; callers embedding this in a long-lived jitted pipeline bind
+    the backend at trace time (set the knob before compiling, as with
+    the program-shape constants in utils/planconfig.py)."""
+    fn = (
+        expand_inline_grouped_pallas
+        if use_slotmap_pallas()
+        else expand_inline_grouped
+    )
+    return fn(metap, ov_chunks, rows, capc, pcap)
+
+
 @partial(jax.jit, static_argnames=("capc",))
 def expand_inline_seg(
     metap: jnp.ndarray,

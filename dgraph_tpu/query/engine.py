@@ -78,6 +78,15 @@ _packed_expand_csr = _make_packed_expand()
 _packed_expand_inline = _make_packed_inline()
 
 
+def _pallas_interpret() -> bool:
+    """Interpret-mode flag for the resident Pallas tier: Mosaic lowering
+    needs real TPU hardware; every other backend runs the kernels under
+    the interpreter (bit-identical semantics, correctness speed)."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
 def _fresh_stats() -> dict:
     """Per-request engine stats: edges traversed + per-stage wall time
     (ms) — the per-query device/host breakdown the reference exposes
@@ -147,6 +156,11 @@ class DeviceExpander:
     def __init__(self, engine: "QueryEngine"):
         self.engine = engine
         self.fused_hop = planconfig.fused_hop()
+        # device-resident Pallas tier (PR 16, ops/pallas_gather.py):
+        # "0" never / "1" auto (TPU backend only — default CPU serving
+        # stays byte-identical to the staged routes) / "force" (any
+        # backend, interpret kernels on CPU; the parity-test mode)
+        self.resident_mode = planconfig.resident()
         # cross-session hop coalescing: the cohort scheduler
         # (sched/scheduler.py) installs one HopMerger per cohort so
         # same-(arena, predicate, direction) expansions from different
@@ -173,6 +187,15 @@ class DeviceExpander:
         import jax
 
         return jax.default_backend() == "cpu"
+
+    def _use_resident(self) -> bool:
+        if self.resident_mode == "0":
+            return False
+        if self.resident_mode == "force":
+            return True
+        import jax
+
+        return jax.default_backend() == "tpu"
 
     def expand(
         self, arena, src: np.ndarray, attr: str = "", reverse: bool = False
@@ -447,7 +470,10 @@ class DeviceExpander:
         # algo/uidlist.go:56-64, priced from MEASURED rates instead of a
         # magic number); static expand_device_min compare when the
         # planner is off or the knob is pinned
-        use_device, dec = planner.expand_route(total, eng.expand_device_min)
+        use_resident = self._use_resident() and hasattr(arena, "resident")
+        use_device, dec = planner.expand_route(
+            total, eng.expand_device_min, resident=use_resident
+        )
         if dec is not None:
             planner.record(eng.stats, dec)
             self._expand_dec = dec
@@ -461,6 +487,56 @@ class DeviceExpander:
             # a device dispatch costs a transport round trip that dwarfs
             # the work
             return self._host_fallback(arena, rows)
+        if use_resident:
+            # device-resident Pallas tier (PR 16): walk the CSR pinned
+            # in HBM (ops/pallas_gather.py over ResidentArena's epoch
+            # buffers) — no ``ensure_device`` restage rides this
+            # dispatch; only the frontier crosses h2d and only the
+            # packed result crosses d2h, which is exactly what the
+            # ledger charges below (the tier's transfer contract).
+            # Order-agnostic like the CSR route, so it sits above the
+            # ascending-only ladder.  Devguard brackets it as a
+            # device-domain route: a classified fault lands on the
+            # byte-identical host fallback.
+            self._route = "resident"
+            interp = _pallas_interpret()
+
+            def _dispatch_resident():
+                fail.point("device.hop")
+                # plain-data return: ledger/span writes stay on the
+                # caller thread (see _dispatch_inline's note)
+                with obs.stage(eng.stats, "device_expand_ms"):
+                    ra = arena.resident()
+                    dev = ra.expand_packed(
+                        ops.pad_rows(rows, ops.bucket(n)).astype(np.int32),
+                        cap, interpret=interp,
+                    )
+                    sync_ms = (
+                        obs.block_ready_ms(dev)
+                        if self._span is not None else None
+                    )
+                    # one fetch: out|seg concatenated on device
+                    return np.asarray(dev), sync_ms
+
+            got = self._run_guarded("device.hop", _dispatch_resident)
+            if got is None:
+                return self._host_fallback(arena, rows)
+            packed, sync_ms = got
+            led = _ledger.current()
+            if sync_ms is not None and self._span is not None:
+                self._span.set_attr("device_sync_ms", round(sync_ms, 3))
+                if led is not None:
+                    led.device_sync_ms += sync_ms
+            if led is not None:
+                led.bytes_h2d += int(rows.nbytes)
+                led.bytes_d2h += int(packed.nbytes)
+            out = packed[:total].astype(np.int64)
+            seg = packed[cap : cap + total].astype(np.int64)
+            counts = np.bincount(seg, minlength=n)
+            seg_ptr = np.zeros(n + 1, dtype=np.int64)
+            np.cumsum(counts, out=seg_ptr[1:])
+            eng.stats["edges"] += len(out)
+            return out, seg_ptr
         # big single-device expansion.  The inline-head fast path (one
         # 32B row gather serves metadata + the first INLINE targets;
         # docs/ROOFLINE.md round 4) and the classed-gather path both

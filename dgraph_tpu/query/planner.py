@@ -227,6 +227,7 @@ def note_outcome(dec: Optional[dict], actual_us: float) -> None:
 _RATE_KEY = {
     ("expand", "host"): ("host_edge_us", 0.0),
     ("expand", "device"): ("device_edge_us", 1.0),   # minus one dispatch
+    ("expand", "resident"): ("resident_edge_us", 1.0),  # PR 16 Pallas tier
     ("kway", "host"): ("host_intersect_us", 0.0),
     ("kway", "device"): ("device_intersect_us", 1.0),
 }
@@ -309,11 +310,21 @@ def chain_route(
 
 
 def expand_route(
-    total: int, configured_min: int
+    total: int, configured_min: int, resident: bool = False
 ) -> Tuple[bool, Optional[dict]]:
     """Host numpy or one device dispatch for a single level's expansion?
     Returns (use_device, decision).  Static compare when the planner is
-    off or the knob is pinned (env or runtime assignment)."""
+    off or the knob is pinned (env or runtime assignment).
+
+    ``resident=True`` (PR 16): the engine's device dispatch for this
+    arena is the device-resident Pallas gather (query/engine.py
+    route:resident), so the device side is priced at
+    ``resident_edge_us`` with a ZERO h2d staging term — no
+    ``ensure_device`` re-upload ever rides this route, which is the
+    whole point of the tier; the missing staging tax is what moves the
+    break-even, not a faster kernel.  The decision's route string is
+    "resident" so ``note_outcome`` refines the resident rate, never the
+    staged one."""
     if (
         not enabled()
         or planconfig.overridden("DGRAPH_TPU_EXPAND_DEVICE_MIN")
@@ -322,17 +333,21 @@ def expand_route(
         return total >= configured_min, None
     r = rates()
     host_c = r["host_setup_us"] + total * r["host_edge_us"]
-    dev_c = _device_factor() * (
-        r["dispatch_us"] + total * r["device_edge_us"]
-    )
+    edge = r["resident_edge_us"] if resident else r["device_edge_us"]
+    dev_c = _device_factor() * (r["dispatch_us"] + total * edge)
     use_device = dev_c < host_c
+    dev_route = "resident" if resident else "device"
     dec = {
         "kind": "expand",
-        "route": "device" if use_device else "host",
+        "route": dev_route if use_device else "host",
         "units": int(total),
         "est_chosen_us": round(dev_c if use_device else host_c, 1),
         "est_other_us": round(host_c if use_device else dev_c, 1),
-        "reason": "calibrated host/device break-even",
+        "reason": (
+            "calibrated host/resident break-even (zero staging term)"
+            if resident
+            else "calibrated host/device break-even"
+        ),
     }
     return use_device, dec
 

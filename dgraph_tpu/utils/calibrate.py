@@ -51,6 +51,14 @@ class Calibration:
 
     dispatch_us: float = 120.0       # fixed cost of one device program
     device_edge_us: float = 0.018    # per-edge device gather rate
+    resident_edge_us: float = 0.010  # per-edge rate of the resident
+                                     # Pallas gather (PR 16): below
+                                     # device_edge_us because the route
+                                     # pays ZERO h2d staging — no
+                                     # ensure_device re-upload rides the
+                                     # dispatch (the prior encodes the
+                                     # missing term, not a faster ALU;
+                                     # online refinement converges it)
     host_edge_us: float = 0.032      # per-edge host numpy gather rate
     host_touch_us: float = 0.010     # per-edge host conversion/dedup the
                                      # per-level path pays that a fused
@@ -70,7 +78,8 @@ class Calibration:
                                      # interval math — wallclock rule)
 
     _RATE_FIELDS = (
-        "dispatch_us", "device_edge_us", "host_edge_us", "host_touch_us",
+        "dispatch_us", "device_edge_us", "resident_edge_us",
+        "host_edge_us", "host_touch_us",
         "host_setup_us", "chain_plan_us", "host_intersect_us",
         "device_intersect_us", "tile_mac_us", "combine_us_per_mac",
         "tile_build_us_per_lane", "tile_build_amortize",

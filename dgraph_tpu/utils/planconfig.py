@@ -58,6 +58,19 @@ DGRAPH_TPU_CALIBRATION_FILE  scratch/planner_calib.json
 DGRAPH_TPU_CALIBRATE          "0"    "1" re-measures at server boot and
                                      re-persists (stale-calibration
                                      remedy); default boots load the file
+DGRAPH_TPU_RESIDENT           "1"    device-resident Pallas hop tier
+                                     (query/engine.py route:resident):
+                                     0 never / 1 auto (TPU backend only
+                                     — CPU serving stays byte-identical
+                                     to the staged routes) / force
+                                     (any backend, interpret kernels on
+                                     CPU; the parity-test mode)
+DGRAPH_TPU_SLOTMAP            "1"    Pallas slot-map kernel in grouped
+                                     inline expansions (ops/sets.py
+                                     expand_inline_grouped_auto): 0 XLA
+                                     scan/scatter always / 1 auto (TPU
+                                     backend only) / force (any backend,
+                                     interpret mode off-TPU)
 DGRAPH_TPU_IVM_REPAIR         "1"    IVM delta repair of cached hop
                                      entries / tile blocks: 0 drop-only /
                                      1 cost-gated / force (skip the
@@ -180,6 +193,23 @@ def tile_budget() -> int:
 def fused_hop() -> str:
     """DGRAPH_TPU_FUSED_HOP: classed-gather hop gate ('0'/'1'/'force')."""
     return os.environ.get("DGRAPH_TPU_FUSED_HOP", "1")
+
+
+def resident() -> str:
+    """DGRAPH_TPU_RESIDENT: device-resident hop tier gate ('0' never /
+    '1' auto: TPU backend only, so default CPU serving never diverges
+    from the staged routes / 'force': any backend — Pallas interpret
+    mode on CPU, the mode the parity tests pin)."""
+    return os.environ.get("DGRAPH_TPU_RESIDENT", "1")
+
+
+def slotmap_pallas() -> str:
+    """DGRAPH_TPU_SLOTMAP: grouped-expansion slot-map backend ('0' XLA
+    scan/scatter chain always / '1' auto: the Pallas kernel on the TPU
+    backend only, so default CPU serving compiles no interpret-mode
+    programs / 'force': the Pallas kernel on any backend, interpret mode
+    off-TPU — the mode the parity tests pin)."""
+    return os.environ.get("DGRAPH_TPU_SLOTMAP", "1")
 
 
 def expand_impl() -> str:

@@ -897,6 +897,12 @@ _CLI_BAD = {
     "naked-atomic-write": (
         "import os\n\ndef f(tmp, path):\n    os.replace(tmp, path)\n"
     ),
+    "naked-resident-transfer": (
+        "import numpy as np\n\n"
+        "def f(arena):\n"
+        "    ra = arena.resident()\n"
+        "    return np.asarray(ra.dst)\n"
+    ),
 }
 
 

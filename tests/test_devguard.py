@@ -513,3 +513,76 @@ def test_eviction_races_inflight_expand_never_serves_dropped_arena():
     arena = am.data("a")
     out, _seg = eng.expander._expand_cached(arena, src, "a")
     assert len(out) == 33, "stale dropped-arena entry served after write"
+
+
+def test_delta_epoch_flip_races_inflight_expand_never_serves_stale():
+    """Delta-driven twin of the eviction race above (PR 16): apply_delta
+    mutates the arena IN PLACE — same object, same id(), so the PR-15
+    id-purge never fires — and bumps only its epoch.  Entries filled at
+    the pre-delta epoch must never satisfy a post-delta probe: every
+    writer round adds one edge, so two expansions observing the SAME
+    epoch must serve identical edge counts (a stale hit would pair an
+    old count with a new epoch), and counts must grow with the epoch."""
+    st = PostingStore()
+    st.apply_schema("a: uid .")
+    for i in range(1, 33):
+        st.set_edge("a", i, i + 1)
+    eng = QueryEngine(st)
+    am = eng.arenas
+    assert am.hop_cache is not None
+    src = np.arange(1, 33, dtype=np.int64)
+
+    stop = threading.Event()
+    errs = []
+
+    seen = {}  # epoch -> edge count served at that epoch
+
+    def expander():
+        while not stop.is_set():
+            try:
+                arena = am.data("a")
+                e0 = arena.epoch
+                out, _seg = eng.expander._expand_cached(arena, src, "a")
+                if arena.epoch != e0:
+                    continue  # flip mid-read: no epoch to pin it to
+                n = len(out)
+                want = seen.setdefault(e0, n)
+                if n != want:
+                    errs.append(
+                        f"epoch {e0} served {n} edges, previously {want}"
+                    )
+                prior = [v for k, v in seen.items() if k < e0]
+                if prior and n < max(prior):
+                    errs.append(
+                        f"epoch {e0} served {n} < earlier epoch's "
+                        f"{max(prior)}"
+                    )
+            except Exception as e:  # pragma: no cover - surfaced below
+                errs.append(repr(e))
+
+    t = threading.Thread(target=expander, daemon=True)
+    t.start()
+    flips = 0
+    try:
+        deadline = time.monotonic() + 1.5
+        while time.monotonic() < deadline:
+            st.set_edge("a", 1, 1000 + flips)
+            am.data("a")  # refresh applies the delta: epoch flip in place
+            flips += 1
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errs, errs[:3]
+    assert flips > 0
+    # post-storm: the cache holds NOTHING keyed before the last flip
+    # (journal windows may coalesce writer rounds, so the final epoch
+    # can trail `flips` — but every written edge must be served), and a
+    # fresh expansion reflects every write
+    a = am.data("a")
+    assert a.epoch > 0
+    stale = am.hop_cache._c.drop_where(
+        lambda k: k[0] == id(a) and k[3] != a.epoch
+    )
+    assert stale == 0, f"{stale} stale-epoch entries survived the storm"
+    out, _seg = eng.expander._expand_cached(a, src, "a")
+    assert len(out) == 32 + flips, "stale-epoch entry served after storm"

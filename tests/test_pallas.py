@@ -1,8 +1,18 @@
-"""Pallas fused slot-map kernel == the XLA scatter/scan construction.
+"""Pallas kernel tier parity suite (`pallas-interpret` CI job).
+
+Every kernel is pinned byte-identical against TWO references — a pure
+numpy/python oracle AND the XLA program it replaces:
+
+- slot-map (ops/pallas_slotmap.py) vs slotmap_reference + _ov_slot_map,
+  promoted behind DGRAPH_TPU_SLOTMAP (expand_inline_grouped_auto);
+- segment-gather (ops/pallas_gather.py) vs gather_reference +
+  expand_csr, over the real ResidentArena slack-padded layout;
+- k-way intersect (ops/pallas_intersect.py) vs intersect_reference +
+  intersect_many, k in {2, 4, 8}.
 
 Runs in Pallas interpret mode (CPU backend, like the rest of the suite).
 Interpret mode skips Mosaic lowering: TPU compilation is intended but
-unverified until the next real-chip session (see the kernel docstring).
+unverified until the next real-chip session (see the kernel docstrings).
 """
 
 import numpy as np
@@ -10,6 +20,10 @@ import pytest
 
 import jax
 import jax.numpy as jnp
+
+# the pallas-interpret CI job re-runs this module on its own (these
+# tests also run inside tier-1 — the marker adds a name, not an excuse)
+pytestmark = pytest.mark.pallas_interpret
 
 
 def _grouped_case(rng, n_rows, pcap):
@@ -195,3 +209,268 @@ def test_slotmap_pallas_dense_and_edge_cases():
                        interpret=True)
     )[0]
     assert (got == -1).all()
+
+
+# ----------------------------------------------------- segment-gather kernel
+#
+# gather_pallas walks a ResidentArena-layout CSR (SENT slack-padded dst,
+# bucketed offsets) — every case below runs the kernel over the REAL
+# seeded layout and byte-compares against BOTH the pure-numpy oracle
+# (gather_reference) and the staged XLA program (expand_csr), the two
+# references the resident engine route must be indistinguishable from.
+
+
+def _seeded_csr(rng, n, n_edges):
+    from dgraph_tpu.models.arena import ResidentArena, csr_dense_from_edges
+
+    src = rng.integers(1, n, size=n_edges)
+    dst = rng.integers(1, n, size=n_edges)
+    a = csr_dense_from_edges(src, dst, n)
+    ra = ResidentArena.seed(a.h_offsets, a.host_dst(), a.n_rows, a.n_edges)
+    return a, ra
+
+
+def _gather_check(a, ra, rows, cap):
+    from dgraph_tpu import ops
+
+    rj = jnp.asarray(rows)
+    out, seg, total = ops.gather_pallas(ra.off, ra.dst, rj, cap,
+                                        interpret=True)
+    w_out, w_seg, w_total = ops.gather_reference(
+        a.h_offsets, a.host_dst(), rows, cap
+    )
+    assert int(total) == min(w_total, 2**31 - 1)
+    assert np.array_equal(np.asarray(out), w_out)
+    assert np.array_equal(np.asarray(seg), w_seg)
+    # XLA reference: the staged program the resident route replaces
+    x_out, x_seg, x_total = ops.expand_csr(
+        jnp.asarray(a.h_offsets.astype(np.int32)),
+        jnp.asarray(a.host_dst().astype(np.int32)),
+        rj, cap,
+    )
+    assert np.array_equal(np.asarray(out), np.asarray(x_out))
+    assert np.array_equal(np.asarray(seg), np.asarray(x_seg))
+    assert int(total) == int(x_total)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_gather_pallas_matches_oracle_and_xla(seed):
+    from dgraph_tpu import ops
+
+    rng = np.random.default_rng(seed)
+    a, ra = _seeded_csr(rng, 500, 6000)
+    f = np.unique(rng.integers(0, a.n_rows, size=64)).astype(np.int64)
+    rows = ops.pad_rows(f, ops.bucket(len(f))).astype(np.int32)
+    cap = ops.bucket(int(np.sum(
+        a.h_offsets[f + 1] - a.h_offsets[f]
+    )) or 1)
+    _gather_check(a, ra, rows, cap)
+
+
+def test_gather_pallas_empty_frontier():
+    from dgraph_tpu import ops
+
+    rng = np.random.default_rng(3)
+    a, ra = _seeded_csr(rng, 100, 800)
+    rows = np.full(8, -1, dtype=np.int32)  # all pad lanes
+    out, seg, total = ops.gather_pallas(ra.off, ra.dst, jnp.asarray(rows),
+                                        128, interpret=True)
+    assert int(total) == 0
+    assert (np.asarray(out) == ops.SENT).all()
+    assert (np.asarray(seg) == -1).all()
+
+
+def test_gather_pallas_padded_rows_interleaved():
+    """-1 pad lanes ANYWHERE in the frontier (not just the tail): each
+    is skipped without consuming an output slot, matching pad_rows-style
+    engine frontiers and the oracle's row<0 skip."""
+    from dgraph_tpu import ops
+
+    rng = np.random.default_rng(4)
+    a, ra = _seeded_csr(rng, 300, 4000)
+    rows = np.array([-1, 5, -1, 17, 42, -1, 99, -1], dtype=np.int32)
+    cap = ops.bucket(int(np.sum(np.diff(a.h_offsets))) or 1)
+    _gather_check(a, ra, rows, cap)
+
+
+def test_gather_pallas_heavy_row_straddles_tiles():
+    """One row's posting span crosses several 128-lane VMEM tiles (deg
+    300 > 2 tiles) plus a trailing light row whose leading tile must
+    overwrite the heavy row's tail-tile garbage."""
+    from dgraph_tpu import ops
+    from dgraph_tpu.models.arena import ResidentArena, csr_dense_from_edges
+
+    heavy = np.full(300, 7, dtype=np.int64)
+    light = np.array([9, 9, 9], dtype=np.int64)
+    src = np.concatenate([heavy, light])
+    dst = np.arange(1, len(src) + 1, dtype=np.int64)
+    a = csr_dense_from_edges(src, dst, 16)
+    ra = ResidentArena.seed(a.h_offsets, a.host_dst(), a.n_rows, a.n_edges)
+    rows = ops.pad_rows(
+        np.array([np.searchsorted(a.h_src, 7),
+                  np.searchsorted(a.h_src, 9)], dtype=np.int64),
+        8,
+    ).astype(np.int32)
+    _gather_check(a, ra, rows, ops.bucket(303))
+
+
+def test_gather_pallas_truncates_at_cap():
+    """cap below the frontier's total degree: silent truncation, total
+    reports the untruncated count — both exactly as the oracle."""
+    from dgraph_tpu import ops
+
+    rng = np.random.default_rng(5)
+    a, ra = _seeded_csr(rng, 200, 3000)
+    f = np.arange(0, min(a.n_rows, 64), dtype=np.int64)
+    rows = ops.pad_rows(f, 64).astype(np.int32)
+    _gather_check(a, ra, rows, 128)
+
+
+def test_gather_pallas_packed_layout():
+    """The packed variant is exactly concat([out, seg]) of the unpacked
+    one — the single-fetch layout the engine's resident hop reads."""
+    from dgraph_tpu import ops
+
+    rng = np.random.default_rng(6)
+    a, ra = _seeded_csr(rng, 200, 2500)
+    f = np.unique(rng.integers(0, a.n_rows, size=32)).astype(np.int64)
+    rows = jnp.asarray(ops.pad_rows(f, 32).astype(np.int32))
+    cap = 4096
+    out, seg, _ = ops.gather_pallas(ra.off, ra.dst, rows, cap,
+                                    interpret=True)
+    packed = np.asarray(ops.gather_pallas_packed(ra.off, ra.dst, rows, cap,
+                                                 interpret=True))
+    assert packed.shape == (2 * cap,)
+    assert np.array_equal(packed[:cap], np.asarray(out))
+    assert np.array_equal(packed[cap:], np.asarray(seg))
+
+
+# ------------------------------------------------------ k-way intersect
+
+
+def _sets_case(rng, k, L, universe, density):
+    """k sorted-unique SENT-padded rows with a controllable overlap."""
+    from dgraph_tpu import ops
+
+    rows = []
+    for _ in range(k):
+        m = int(rng.integers(1, max(2, int(L * density))))
+        rows.append(ops.pad_to(
+            np.unique(rng.integers(0, universe, size=m)).astype(np.int32), L
+        ))
+    return np.stack([np.asarray(r) for r in rows])
+
+
+@pytest.mark.parametrize("k", [2, 4, 8])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_intersect_pallas_matches_reference_and_xla(k, seed):
+    from dgraph_tpu import ops
+
+    rng = np.random.default_rng(10 * k + seed)
+    # small universe → dense overlap; large → sparse/empty results
+    for universe in (40, 5000):
+        mat = _sets_case(rng, k, 192, universe, 0.8)
+        got = np.asarray(ops.intersect_pallas(jnp.asarray(mat),
+                                              interpret=True))
+        want = ops.intersect_reference(mat)
+        valid = got[got != ops.SENT]
+        assert valid.tolist() == list(want)
+        assert (got[len(valid):] == ops.SENT).all()
+        xla = np.asarray(ops.intersect_many(jnp.asarray(mat)))
+        assert np.array_equal(got, xla)
+
+
+def test_intersect_pallas_empty_set_annihilates():
+    """One all-SENT row forces an empty intersection regardless of the
+    other lanes — and an ALL-empty stack stays empty."""
+    from dgraph_tpu import ops
+
+    rng = np.random.default_rng(11)
+    mat = _sets_case(rng, 4, 128, 30, 0.9)
+    mat[2, :] = ops.SENT
+    got = np.asarray(ops.intersect_pallas(jnp.asarray(mat), interpret=True))
+    assert (got == ops.SENT).all()
+    assert np.array_equal(
+        got, np.asarray(ops.intersect_many(jnp.asarray(mat)))
+    )
+    allempty = np.full((8, 256), ops.SENT, np.int32)
+    got = np.asarray(
+        ops.intersect_pallas(jnp.asarray(allempty), interpret=True)
+    )
+    assert (got == ops.SENT).all()
+
+
+def test_intersect_pallas_identical_rows_roundtrip():
+    from dgraph_tpu import ops
+
+    s = np.unique(np.arange(0, 500, 7, dtype=np.int32))
+    row = np.asarray(ops.pad_to(s, 128))
+    mat = np.stack([row] * 8)
+    got = np.asarray(ops.intersect_pallas(jnp.asarray(mat), interpret=True))
+    assert got[: len(s)].tolist() == s.tolist()
+    assert (got[len(s):] == ops.SENT).all()
+
+
+# -------------------------------------------- program-count discipline
+
+
+@pytest.mark.compile_budget(None)
+def test_repeat_shapes_compile_zero_new_programs():
+    """The resident tier's serving-loop discipline: after the first call
+    at a given (shape, cap) key, repeated hops at the same shapes launch
+    the CACHED program — zero new XLA compilations (the same pin the
+    bucketed staged routes carry, analysis/budgets.json)."""
+    from dgraph_tpu import ops
+    from dgraph_tpu.analysis.pytest_budget import compile_count
+
+    rng = np.random.default_rng(12)
+    a, ra = _seeded_csr(rng, 300, 4000)
+    f = np.unique(rng.integers(0, a.n_rows, size=40)).astype(np.int64)
+    rows = jnp.asarray(ops.pad_rows(f, 64).astype(np.int32))
+    mat = jnp.asarray(_sets_case(rng, 4, 128, 60, 0.8))
+    # warm every program once (compiles allowed here)
+    ops.gather_pallas_packed(ra.off, ra.dst, rows, 4096, interpret=True)
+    ops.intersect_pallas(mat, interpret=True)
+    c0 = compile_count()
+    for _ in range(3):
+        ops.gather_pallas_packed(ra.off, ra.dst, rows, 4096, interpret=True)
+        ops.intersect_pallas(mat, interpret=True)
+    assert compile_count() == c0, "repeat shapes recompiled"
+
+
+# ------------------------------------- slot-map promotion (DGRAPH_TPU_SLOTMAP)
+
+
+def test_grouped_auto_force_matches_xla(monkeypatch):
+    """expand_inline_grouped_auto under DGRAPH_TPU_SLOTMAP=force (the
+    parity-test mode) is byte-identical to the XLA grouped path on real
+    arena data; '0' pins the XLA path; '1' on CPU stays XLA (the
+    backend gate)."""
+    from dgraph_tpu import ops
+    from dgraph_tpu.models.arena import csr_dense_from_edges
+
+    rng = np.random.default_rng(21)
+    n = 600
+    src = rng.integers(1, n, size=7000)
+    dst = rng.integers(1, n, size=7000)
+    a = csr_dense_from_edges(src, dst, n)
+    metap, ov = a.inline_layout_grouped()
+    deg = a.h_offsets[1:] - a.h_offsets[:-1]
+    f = np.unique(rng.integers(1, n, size=80))
+    key = np.asarray(ops.skey_encode(f, deg[f] > ops.INLINE))
+    f = f[np.argsort(key)]
+    pcap = ops.bucket_fine(int((deg[f] > ops.INLINE).sum()) or 1)
+    capc = ops.bucket_fine(int(a.ov_chunk_degree_of_rows(f).sum()) or 1)
+    rows = jax.device_put(np.asarray(f, np.int32))
+    want = ops.expand_inline_grouped(metap, ov, rows, capc, pcap)
+
+    monkeypatch.setenv("DGRAPH_TPU_SLOTMAP", "force")
+    assert ops.use_slotmap_pallas() is True
+    got = ops.expand_inline_grouped_auto(metap, ov, rows, capc, pcap)
+    for w, g in zip(want, got):
+        assert np.array_equal(np.asarray(w), np.asarray(g))
+
+    monkeypatch.setenv("DGRAPH_TPU_SLOTMAP", "0")
+    assert ops.use_slotmap_pallas() is False
+    monkeypatch.setenv("DGRAPH_TPU_SLOTMAP", "1")
+    assert ops.use_slotmap_pallas() is False  # CPU backend: auto = off

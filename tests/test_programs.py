@@ -57,10 +57,13 @@ def _jnp():
 def test_registry_has_ten_plus_full_contracts():
     full = [c for c in programs.REGISTRY.values() if not c.experimental]
     assert len(full) >= 10
-    # pallas_slotmap satellite: registered, explicitly experimental,
-    # with the why in its notes
-    pal = programs.REGISTRY["pallas.slotmap"]
-    assert pal.experimental and "EXPERIMENTAL" in pal.notes
+    # the PR-16 kernel tier: slotmap PROMOTED to a full contract, and
+    # the resident data plane's programs all under full contracts too
+    for name in (
+        "pallas.slotmap", "pallas.gather", "pallas.intersect",
+        "resident.merge",
+    ):
+        assert not programs.REGISTRY[name].experimental, name
     # every contract's covers + exemptions feed the lint acceptance set
     cov = programs.covered_sites()
     for c in programs.REGISTRY.values():
