@@ -120,6 +120,70 @@ def main():
             "ratio_local_over_sharded": round(lo_ms / sh_ms, 2),
         })
 
+    # serving-plane arm (PR 17): the same expansions dispatched THROUGH
+    # the MeshExecutor entry points the server actually calls
+    # (dgraph_tpu/mesh/executor.py) — devguard bracket + placement +
+    # attribution included — plus the fused multi-hop program whose
+    # cross-chip frontier exchange runs between scan levels on the ICI,
+    # A/B'd against the same hops as separate per-level dispatches.
+    from dgraph_tpu.mesh.executor import MeshExecutor
+    from dgraph_tpu.mesh.programs import exchange_bytes_per_hop
+
+    class _Arenas:
+        """The executor's ArenaManager surface, minimally: one already
+        sharded predicate (the bench controls placement explicitly)."""
+
+        def __init__(self, mesh, sa):
+            self.mesh = mesh
+            self._sa = sa
+
+        def sharded_csr(self, attr, reverse=False):
+            return self._sa
+
+    ex = MeshExecutor(_Arenas(mesh, sa))
+    stats = {}
+    ex.expand("link", False, frontiers[0], cap, stats)  # warm
+    t0 = time.time()
+    for f in frontiers:
+        ex.expand("link", False, f, cap, stats)
+    exec_s = time.time() - t0
+
+    n_hops = int(os.environ.get("BM_HOPS", 3))
+    hop_cap = ops.bucket(int(os.environ.get("BM_HOP_CAP", 65536)))
+    seed_f = frontiers[0][: min(len(frontiers[0]), hop_cap)]
+    ex.multi_hop("link", False, seed_f, n_hops, hop_cap, stats)  # warm
+    t0 = time.time()
+    fs, _totals = ex.multi_hop("link", False, seed_f, n_hops, hop_cap, stats)
+    fused_s = time.time() - t0
+    # the ladder: the same traversal as n_hops separate sharded
+    # dispatches, each frontier crossing the host between levels —
+    # exactly the per-hop round trip the fused program deletes
+    from dgraph_tpu.ops.sets import SENT
+
+    t0 = time.time()
+    f = seed_f
+    ladder = []
+    for _ in range(n_hops):
+        o, _ptr = ex.expand("link", False, f, hop_cap, stats)
+        f = np.unique(o)[: hop_cap]
+        ladder.append(f)
+    ladder_s = time.time() - t0
+    # parity: the fused program's per-level frontiers match the ladder's
+    for lvl in range(n_hops):
+        got = np.asarray(fs[lvl])
+        got = got[got != SENT]
+        assert np.array_equal(got, ladder[lvl][: len(got)]), f"hop {lvl}"
+
+    executor = {
+        "expand_ms": round(exec_s / len(frontiers) * 1e3, 1),
+        "n_hops": n_hops,
+        "hop_cap": hop_cap,
+        "fused_multi_hop_ms": round(fused_s * 1e3, 1),
+        "ladder_multi_hop_ms": round(ladder_s * 1e3, 1),
+        "ratio_ladder_over_fused": round(ladder_s / fused_s, 2),
+        "exchange_bytes_per_hop": exchange_bytes_per_hop(mesh, hop_cap),
+    }
+
     print(json.dumps({
         "metric": "mesh_sharded_vs_local_expand",
         "edges_per_query": edges // len(frontiers),
@@ -131,6 +195,7 @@ def main():
         "build_s": round(build_s, 1),
         "shard_s": round(shard_s, 1),
         "crossover_curve": curve,
+        "executor": executor,
     }))
 
 

@@ -47,6 +47,10 @@ Knobs (env, all sized for the 2-core CI host by default):
   SLO_IVM_WRITE_RATES  write-rate sweep, writes/s CSV ("0,10,25")
   SLO_SEED           RNG seed (7)
   SLO_OUT            also write the JSON to this path
+  --backend mesh     (or SLO_BACKEND=mesh) force the mesh serving plane
+                     in every server arm (DGRAPH_TPU_MESH=force, all
+                     predicates shard-eligible); the JSON's backend key
+                     becomes "<backend>-mesh"
   SLO_SMOKE          arm the CI smoke assertions (monotone shed rate,
                      well-formed JSON) — see .github/workflows/ci.yml
 """
@@ -63,6 +67,34 @@ import time
 import numpy as np
 
 from bench import _serving_store, ensure_backend
+
+
+# ---------------------------------------------------------------- backend
+
+def _backend_arg() -> str:
+    """``--backend mesh`` (or SLO_BACKEND=mesh): run every server arm
+    with the mesh serving plane forced on (DGRAPH_TPU_MESH=force, every
+    predicate shard-eligible), so the SLO curve measures serving over
+    the whole mesh — the output JSON is keyed by backend, so mesh and
+    unsharded curves from the same host are directly comparable."""
+    if "--backend" in sys.argv:
+        which = sys.argv[sys.argv.index("--backend") + 1]
+    else:
+        which = os.environ.get("SLO_BACKEND", "default")
+    if which not in ("default", "mesh"):
+        raise SystemExit(f"unknown --backend {which!r} (default | mesh)")
+    return which
+
+
+def _backend_env() -> dict:
+    """Extra env pinned into every _ServerArm regime for the selected
+    backend (empty = the unsharded default)."""
+    if _backend_arg() == "mesh":
+        return {
+            "DGRAPH_TPU_MESH": "force",
+            "DGRAPH_TPU_MESH_SHARD_ROWS": "1",
+        }
+    return {}
 
 
 # ---------------------------------------------------------------- helpers
@@ -365,6 +397,7 @@ def run_sweep(store, mix_weights: list, rates, secs, workers, seed) -> dict:
     with _ServerArm(store, {
         "DGRAPH_TPU_SCHED": "1",
         "DGRAPH_TPU_CACHE": os.environ.get("SLO_CACHE", "1"),
+        **_backend_env(),
     }) as srv:
         classes = [
             {**c, "rate": 0.0} for c in mix_weights
@@ -428,6 +461,7 @@ def run_qos_arm(store, rates, secs, workers, seed) -> dict:
             "DGRAPH_TPU_CACHE": "0",  # a cached antagonist stresses nothing
             "DGRAPH_TPU_QOS": qos,
             "DGRAPH_TPU_QOS_TENANTS": tenants,
+            **_backend_env(),
         }) as srv:
             classes = [
                 {"name": "victim", "rate": victim_rate,
@@ -479,6 +513,7 @@ def run_ivm_arm(store, secs, workers, seed) -> dict:
         "DGRAPH_TPU_SCHED": "1",
         "DGRAPH_TPU_CACHE": "1",
         "DGRAPH_TPU_IVM": "1",
+        **_backend_env(),
     }) as srv:
         classes = [{
             "name": "read", "rate": read_rate, "pool": read_pool,
@@ -565,7 +600,14 @@ def run_devfault_arm(store, rates, secs, workers, seed) -> dict:
         ul = ", ".join("0x%x" % u for u in seeds)
         pool.append("{ q(func: uid(%s)) { e { e { c: count(e) } } } }" % ul)
     inject_step = len(rates) // 2
-    out = {"wedge_ms": wedge_ms, "hangs": hangs}
+    # under --backend mesh every eligible hop dispatches through the
+    # mesh plane, so the wedge must land on ITS seam (the PR 17
+    # chip-loss site) — device.hop would never fire, and the arm's
+    # guarded failover is then mesh → unsharded instead of device → host
+    mesh_arm = _backend_arg() == "mesh"
+    site = "device.mesh" if mesh_arm else "device.hop"
+    domain = "mesh" if mesh_arm else "device"
+    out = {"wedge_ms": wedge_ms, "hangs": hangs, "site": site}
     fp_seed = int(os.environ.get("DGRAPH_TPU_FAILPOINT_SEED", "0"))
     for mode, guard in (("devguard_on", "1"), ("devguard_off", "0")):
         fail.reset(fp_seed)
@@ -580,6 +622,7 @@ def run_devfault_arm(store, rates, secs, workers, seed) -> dict:
             # pin every hop onto the device dispatch seam (env override
             # = static gate; the planner yields the decision)
             "DGRAPH_TPU_EXPAND_DEVICE_MIN": "1",
+            **_backend_env(),
         }) as srv:
             # guards read their env at construction: fresh ones per arm
             devguard.reset_for_tests()
@@ -591,7 +634,20 @@ def run_devfault_arm(store, rates, secs, workers, seed) -> dict:
             # not wedged — tightening first would latch the guard sick
             # on warmup compiles and pollute the non-injected steps
             _warmup(srv.port, classes, n=len(pool))
-            devguard.get().hang_ms = _env_f("SLO_DEVFAULT_HANG_MS", 100.0)
+            if mesh_arm and guard == "1":
+                # warm the UNSHARDED fallback programs too: the injected
+                # step's re-planned hops must not pay first-time XLA
+                # compiles (a cold compile is slow, not wedged — it
+                # would smear p999 past the wedge bound the smoke
+                # asserts).  Arm the chip-loss site for the whole pass
+                # so every hop takes the degrade path once, then reset
+                fail.arm(site, "error(n=1000000)")
+                _warmup(srv.port, classes, n=len(pool))
+                fail.reset(fp_seed)
+                devguard.reset_for_tests()
+            devguard.get(domain).hang_ms = _env_f(
+                "SLO_DEVFAULT_HANG_MS", 100.0
+            )
             for step_i, rate in enumerate(rates):
                 classes[0]["rate"] = rate
                 injected = step_i == inject_step
@@ -600,7 +656,7 @@ def run_devfault_arm(store, rates, secs, workers, seed) -> dict:
                     timer = threading.Timer(
                         secs / 2.0,
                         lambda: fail.arm(
-                            "device.hop",
+                            site,
                             f"hang(ms={wedge_ms:g},n={hangs})",
                         ),
                     )
@@ -627,7 +683,7 @@ def run_devfault_arm(store, rates, secs, workers, seed) -> dict:
                     "failovers": (
                         sum(DEVICE_FAILOVER.snapshot().values()) - fo0
                     ),
-                    "device_state": devguard.get().state,
+                    "device_state": devguard.get(domain).state,
                 })
                 print(
                     f"# slo devfault[{mode}] offered={rate} "
@@ -640,7 +696,7 @@ def run_devfault_arm(store, rates, secs, workers, seed) -> dict:
             healed = guard == "0"
             deadline = time.monotonic() + 15.0
             while not healed and time.monotonic() < deadline:
-                healed = devguard.get().state == "healthy"
+                healed = devguard.get(domain).state == "healthy"
                 if not healed:
                     time.sleep(0.1)
         fail.reset(fp_seed)
@@ -698,7 +754,10 @@ def run_slo_bench() -> dict:
 
     out = {
         "metric": "slo_curve",
-        "backend": jax.default_backend(),
+        # keyed by backend: the mesh arm's curve must never be compared
+        # to an unsharded curve under the same key
+        "backend": jax.default_backend()
+        + ("-mesh" if _backend_arg() == "mesh" else ""),
         "nodes": n_nodes,
         "deg": deg,
         "step_seconds": secs,

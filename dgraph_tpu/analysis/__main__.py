@@ -81,7 +81,19 @@ def main(argv=None) -> int:
 
     if ns.programs or ns.update_programs:
         # tier 2 runs alone: it traces/lowers real kernels (imports jax
-        # and the ops modules), a different beast from the AST passes
+        # and the ops modules), a different beast from the AST passes.
+        # The mesh.multi_hop contract builds an 8-wide Mesh, so give
+        # the host platform 8 devices before the backend initializes
+        # (a no-op on real multi-chip backends; tests/conftest.py
+        # forces the same count for in-process runs)
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
         from dgraph_tpu.analysis.programs import run_check
 
         return run_check(

@@ -387,7 +387,7 @@ def check_contract(
                 attrs = donation_attrs(lowered.as_text())
                 flags = _donated_flags(lowered)
                 for argnum in donate:
-                    aliased, _declared = attrs.get(argnum, (False, False))
+                    aliased, declared = attrs.get(argnum, (False, False))
                     donated = bool(
                         flags[argnum]
                     ) if argnum < len(flags) else False
@@ -402,15 +402,23 @@ def check_contract(
                             "the donate_argnums declaration was lost")
                     elif argnum in unused_ok:
                         pass  # declared, legitimately unaliased carry
-                    elif not aliased:
+                    elif not aliased and not declared:
+                        # single-device programs pin the alias pair
+                        # statically (tf.aliasing_output) and DROP the
+                        # attribute entirely when the donation is
+                        # unusable; sharded (shard_map/pjit) programs
+                        # instead mark jax.buffer_donor and leave the
+                        # pairing to XLA buffer assignment — either
+                        # attr means the buffer is reusable, a bare
+                        # %arg means the donation was lost
                         bad(inst.key, "donation",
                             f"flat arg {argnum} is donated but NOT "
                             "aliased to any output (no "
-                            "tf.aliasing_output attr) — XLA cannot "
-                            "reuse the buffer (shape/dtype mismatch "
-                            "with every output); fix the carry layout "
-                            "or declare it donate_unused_ok with the "
-                            "why")
+                            "tf.aliasing_output / jax.buffer_donor "
+                            "attr) — XLA cannot reuse the buffer "
+                            "(shape/dtype mismatch with every "
+                            "output); fix the carry layout or declare "
+                            "it donate_unused_ok with the why")
             if "cost" in checks:
                 ca = _cost_analysis(lowered)
                 if ca is not None:
@@ -902,6 +910,39 @@ def _b_multi_hop() -> List[ProgramInstance]:
             "H3xC32_visited", batch._multi_hop_jit,
             (offsets, dst, f, vis),
             {"n_hops": 3, "cap": 32, "track_visited": True, "lut": lut},
+        ),
+    ]
+
+
+def _b_mesh_multi_hop() -> List[ProgramInstance]:
+    jnp, np = _jnp()
+    import jax
+
+    from dgraph_tpu.mesh.programs import mesh_multi_hop_step
+    from dgraph_tpu.ops import sets
+    from dgraph_tpu.parallel.mesh import make_mesh, shard_arena_rows
+
+    if len(jax.devices()) < 8:
+        raise RuntimeError(
+            "the mesh.multi_hop contract builds an 8-wide Mesh; run "
+            "under XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "(the analysis CLI injects this itself when the backend is "
+            "uninitialized, and tests/conftest.py forces it for the "
+            "whole suite)"
+        )
+    mesh = make_mesh(8, data=1)
+    h_src, h_offsets, h_dst, _, _ = _small_csr()
+    sa = shard_arena_rows(h_src, h_offsets, h_dst, 8)
+    f32 = jnp.asarray(sets.pad_to(np.array([0, 1, 3], np.int64), 32))
+    f64 = jnp.asarray(sets.pad_to(np.array([0, 1, 3], np.int64), 64))
+    return [
+        ProgramInstance(
+            "H2xC32", mesh_multi_hop_step(mesh, 32, 2),
+            (sa.src, sa.offsets, sa.dst, f32), {},
+        ),
+        ProgramInstance(
+            "H3xC64", mesh_multi_hop_step(mesh, 64, 3),
+            (sa.src, sa.offsets, sa.dst, f64), {},
         ),
     ]
 
@@ -1498,6 +1539,30 @@ REGISTRY: Dict[str, ProgramContract] = {
                   "pairs into the NEXT epoch's (offsets, dst) — the "
                   "device twin of CSRArena._apply_delta_locked.  Only "
                   "the padded delta pairs ever cross h2d." + _SS_NOTE,
+        ),
+        ProgramContract(
+            name="mesh.multi_hop",
+            covers=("dgraph_tpu/mesh/programs.py::mesh_multi_hop_step",),
+            build=_b_mesh_multi_hop,
+            scan_free=False,   # the hop scan IS the design (+ rows_of's
+                               # searchsorted probe)
+            dtypes=_INT_SS,
+            donate=(3,),
+            # the frontier seed aliases the [cap] final-frontier output
+            # across the shard_map boundary; transfer_free stays False
+            # because the checker's host-built operands reshard onto
+            # the 8-wide mesh at call time — on the serving path the
+            # ShardedArena operands are placed once and stay resident
+            # (models/arena.py sharded_csr cache)
+            transfer_free=False,
+            notes="PR 17 mesh serving plane: the whole multi-hop chain "
+                  "as ONE shard_map program — per-hop cross-chip "
+                  "frontier exchange (all_gather of each shard's "
+                  "bucketed expand_csr, psum of edge counts) runs "
+                  "between lax.scan iterations on the ICI, never "
+                  "through the host; byte-parity with the unsharded "
+                  "scan driver pinned by tests/test_mesh_serving.py."
+                  + _SS_NOTE,
         ),
     )
 }

@@ -1291,6 +1291,48 @@ class NakedResidentTransfer(Rule):
                 )
 
 
+# -- rule: naked-collective ---------------------------------------------------
+
+class NakedCollective(Rule):
+    id = "naked-collective"
+    doc = (
+        "shard_map / psum / all_gather / ppermute outside dgraph_tpu/"
+        "mesh/ and dgraph_tpu/parallel/ — cross-chip collectives are "
+        "the mesh plane's contract surface (placement-invariant "
+        "reassembly, exchange-bytes ledger attribution, program "
+        "contracts); a collective grown elsewhere ships none of that"
+    )
+
+    # the two sanctioned homes: parallel/ (per-hop mesh steps) and
+    # mesh/ (the fused serving plane, PR 17)
+    _HOMES = ("dgraph_tpu/mesh/", "dgraph_tpu/parallel/")
+    _COLLECTIVES = frozenset(
+        {"shard_map", "psum", "all_gather", "ppermute"}
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if any(h in path for h in self._HOMES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            name = dotted.rsplit(".", 1)[-1]
+            if name not in self._COLLECTIVES:
+                continue
+            yield ctx.finding(
+                self.id, node,
+                f"cross-chip collective `{dotted}` outside the mesh "
+                "plane: collectives live in dgraph_tpu/mesh/ (fused "
+                "serving programs) or dgraph_tpu/parallel/ (per-hop "
+                "steps), where reassembly stays placement-invariant, "
+                "exchange bytes are ledger-charged, and the program "
+                "carries a checked contract — move the program there "
+                "or pragma the site with the WHY",
+            )
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     HostSyncInJit(),
     RecompileHazard(),
@@ -1306,4 +1348,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     UnregisteredMetric(),
     UnregisteredProgramFactory(),
     NakedResidentTransfer(),
+    NakedCollective(),
 )

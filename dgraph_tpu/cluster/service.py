@@ -721,11 +721,105 @@ class _ClusterUids:
         return self._meta.snapshot()
 
 
+class _PredVersionClock:
+    """Per-predicate cache versions for ClusterStore (duck-typed to the
+    ``pred_versions`` mapping surface ivm/versions.py and the arena
+    manager probe: ``.get(pred, default)`` + ``len()``).
+
+    The obvious implementation — hand back the owning replica's raft
+    index — is WRONG across groups: version_for takes a max over the
+    footprint, and raft indices from different groups share no scale.
+    A footprint {p@groupA, q@groupB} with B's log at index 900 would
+    keep version_for pinned at 900 while p bumps 5→6 on A — the bump
+    is masked and the stale cache entry keeps serving.  So this clock
+    issues CLUSTER-LOCAL monotone ticks: each predicate's tick advances
+    exactly when its source version — the owning local replica's
+    ``pred_version`` (raft index, scoped to one group) or the remote
+    snapshot cache's X-Pred-Version — is observed to change.  Ticks
+    from different groups then compose under max() like PostingStore's
+    single-scale versions do.
+
+    Process-local by design: the caches these versions key (hop/result
+    tiers, arena identity) are process-local too, so a restart starting
+    the ticks over matches the caches starting over."""
+
+    def __init__(self, store: "ClusterStore"):
+        self._store = store
+        self._tick = 0
+        self._seen: Dict[str, Tuple[tuple, int]] = {}  # pred -> (src, tick)
+        self._floors: Dict[int, int] = {}  # gid -> last-seen group floor
+        self._floor_tick = 0
+        self._lock = threading.Lock()
+
+    def _source(self, pred: str) -> Optional[tuple]:
+        """The pred's current content-version coordinate, or None when
+        it has no stable source yet (owner unannounced, or a remote
+        pred never fetched).  Never called under self._lock — the
+        remote-cache read takes _remote_lock."""
+        svc = self._store._svc
+        try:
+            gid = self._store._owner_gid(pred)
+        except OSError:
+            return None
+        g = svc.groups.get(gid)
+        if g is not None:
+            # racy read is fine per pred_version's contract: a torn
+            # observation at worst issues one extra tick (a cache miss)
+            return ("raft", gid, g.pred_version(pred))
+        with self._store._remote_lock:
+            ent = self._store._remote.get(pred)
+        if ent is None:
+            return None
+        return ("remote", gid, ent[0])
+
+    def get(self, pred: str, default: int = 0) -> int:
+        src = self._source(pred)
+        with self._lock:
+            if src is None:
+                # unknown freshness must never look fresh: a new tick
+                # per probe keys the entry but can never match it again
+                self._tick += 1
+                return self._tick
+            ent = self._seen.get(pred)
+            if ent is not None and ent[0] == src:
+                return ent[1]
+            self._tick += 1
+            self._seen[pred] = (src, self._tick)
+            return self._tick
+
+    def floor(self) -> int:
+        """The non-scopeable-change floor: advances when any local
+        group replica's store floor moves (schema apply, raft snapshot
+        restore — bytes_to_state's note_global_change)."""
+        svc = self._store._svc
+        with self._lock:
+            for gid, g in svc.groups.items():
+                f = getattr(g.store, "pred_floor", 0)
+                prev = self._floors.get(gid)
+                if prev is None:
+                    self._floors[gid] = f  # first sight: adopt silently
+                elif prev != f:
+                    self._floors[gid] = f
+                    self._tick += 1
+                    self._floor_tick = self._tick
+            return self._floor_tick
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._seen)
+
+
 class ClusterStore:
     """The engine-facing store: replicated writes, snapshot-stable reads.
 
     Implements PostingStore's read/write surface (duck-typed — the engine
     and serving layer never isinstance-check)."""
+
+    # per-predicate versions exist for CACHE KEYING only: there is no
+    # local mutation journal to stream deltas from, so the serving
+    # layer must not attach an IVM delta stream or subscriptions here
+    # (serve/server.py gates on this)
+    supports_ivm_stream = False
 
     def __init__(self, svc: ClusterService, remote_ttl: float = 0.1):
         self._svc = svc
@@ -757,6 +851,11 @@ class ClusterStore:
         # (predicates) — tuples can never collide with predicate strings.
         self._fetch_locks: Dict[object, threading.Lock] = {}
         self.remote_ttl = remote_ttl
+        # per-predicate cache versions (PR 17): hop/arena caches key on
+        # the touched predicate's tick instead of the global sum, so a
+        # write to one group no longer invalidates every other group's
+        # cached expansions (ivm/versions.py version_for)
+        self.pred_versions = _PredVersionClock(self)
 
     @property
     def dirty(self) -> set:
@@ -788,6 +887,12 @@ class ClusterStore:
             getattr(g.store, "version", 0)
             for g in self._svc.groups.values()
         )
+
+    @property
+    def pred_floor(self) -> int:
+        """The version_for floor (non-scopeable changes) on the
+        cluster clock's scale — see _PredVersionClock.floor."""
+        return self.pred_versions.floor()
 
     # -- schema (metadata group) -------------------------------------------
 

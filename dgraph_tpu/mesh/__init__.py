@@ -1,0 +1,29 @@
+"""Mesh serving plane (PR 17): one server driving the whole TPU mesh.
+
+``parallel/mesh.py`` is the kernel library — shard_map steps, row
+sharding, the packed cross-chip reassembly.  This package is the plane
+that makes those kernels a first-class serving backend:
+
+- :mod:`dgraph_tpu.mesh.plan` — ``MeshPlan``: predicate→shard placement
+  (which chip holds a predicate's shard 0), persisted and
+  rebalance-aware, so co-resident predicates don't all pile their
+  heaviest row shard on the same chip.
+- :mod:`dgraph_tpu.mesh.programs` — the multi-hop mesh program whose
+  cross-chip frontier exchange (all_gather/psum of bucketed frontier
+  buffers) happens INSIDE the compiled hop program, with the frontier
+  carry donated across levels (no host round trip between hops).
+- :mod:`dgraph_tpu.mesh.executor` — ``MeshExecutor``: the engine-facing
+  entry points (one-hop expand, fused multi-hop) that slot in behind
+  ``DeviceExpander``/``chain`` as the planner-priced ``route:mesh``,
+  devguard-bracketed under the "mesh" fault domain and ledger-charged
+  (per-chip device time + exchange bytes).
+
+``DGRAPH_TPU_MESH`` tri-state (serve/server.py::_auto_mesh): "0"/"off"
+never (byte-identical unsharded serving), "1"/"auto"/unset on when >1
+device is visible, "force" always.
+"""
+
+from dgraph_tpu.mesh.executor import MeshExecutor
+from dgraph_tpu.mesh.plan import MeshPlan
+
+__all__ = ["MeshExecutor", "MeshPlan"]

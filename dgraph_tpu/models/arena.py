@@ -990,6 +990,16 @@ class ArenaManager:
         # None = single-device execution
         self.mesh = mesh
         self.shard_threshold = shard_threshold
+        # mesh serving plane (PR 17): predicate→shard placement so
+        # co-resident predicates don't all pile shard 0 (their densest
+        # uid range) on the same chip, plus the memoized serving-path
+        # executor the engine/chain dispatch through
+        self.mesh_plan = None
+        self._mesh_exec = None
+        if mesh is not None:
+            from dgraph_tpu.mesh.plan import MeshPlan
+
+            self.mesh_plan = MeshPlan.load(int(mesh.shape["model"]))
         # single source of truth for host-vs-device expansion routing
         # (engine and FuncResolver both read it; engine may retune at
         # runtime) — see QueryEngine.__init__ for the rationale.  While
@@ -1010,6 +1020,16 @@ class ArenaManager:
         # warm ones.  RLock because accessors nest (has_rows → data).
         self._cache_lock = threading.RLock()
         self._build_locks: Dict[tuple, threading.Lock] = {}
+        # journal-consumption generations: refresh() bumps a predicate's
+        # counter whenever it consumes that predicate's journal window
+        # (delta applied in place OR caches dropped for rebuild).  A
+        # build snapshots the counter before peeking the store and
+        # retries if it moved — otherwise a writer's refresh can pop the
+        # journal while a cold build holds a pre-write peek, and the
+        # build then caches an arena the consumed delta never reaches
+        # (the write is lost with no dirty mark left to repair it).
+        self._inval_gen: Dict[str, int] = {}
+        self._inval_gen_star = 0  # bumped by the "*" full-store clear
         # HBM residency budget (bytes): the analog of the reference's
         # memory-watermark-sized posting LRU (posting/lru.go:57,
         # posting/lists.go:191).  0 = unlimited.  Cold arenas evict
@@ -1043,14 +1063,23 @@ class ArenaManager:
         }
         self.evictions = 0
 
-    def _get_or_build(self, cache, key, build, valid=None):
+    def _get_or_build(self, cache, key, build, valid=None, gen_key=None):
         """cache[key], building OUTSIDE the cache lock under a per-key
         build lock: concurrent readers of other keys proceed; concurrent
         readers of the same key wait for one build instead of duplicating
         it (the pattern of ClusterStore._remote_peek's fetch locks).
         ``valid`` optionally rejects a cached entry (sharded_csr checks
         its source-arena identity).  The build-lock entry is dropped even
-        when the build raises, so a failed build can't wedge the key."""
+        when the build raises, so a failed build can't wedge the key.
+
+        ``gen_key`` (the predicate the build peeks) closes the
+        build-vs-journal race: refresh() consuming a journal window
+        between our peek and our cache commit means the consumed delta
+        can neither reach the arena we are building (it isn't cached
+        yet) nor survive for a later refresh — so the build must retry
+        on a fresh peek.  The commit and the generation check share the
+        cache lock with refresh, so a window consumed after the check
+        necessarily sees (and repairs) the entry we just cached."""
         lkey = (id(cache), key)
         with self._cache_lock:
             a = cache.get(key)
@@ -1065,15 +1094,26 @@ class ArenaManager:
                     self._touch(lkey, a)
                     return a
             try:
-                a = build()
-                with self._cache_lock:
-                    cache[key] = a
-                    self._touch(lkey, a)
-                    self._evict_over_budget(protect=lkey)
+                while True:
+                    with self._cache_lock:
+                        g0 = (
+                            self._inval_gen.get(gen_key, 0),
+                            self._inval_gen_star,
+                        )
+                    a = build()
+                    with self._cache_lock:
+                        if gen_key is not None and g0 != (
+                            self._inval_gen.get(gen_key, 0),
+                            self._inval_gen_star,
+                        ):
+                            continue  # journal consumed mid-build: re-peek
+                        cache[key] = a
+                        self._touch(lkey, a)
+                        self._evict_over_budget(protect=lkey)
+                        return a
             finally:
                 with self._cache_lock:
                     self._build_locks.pop(lkey, None)
-            return a
 
     def _touch(self, lkey: tuple, obj) -> None:
         """LRU bookkeeping under _cache_lock: refresh recency + size (lazy
@@ -1217,6 +1257,7 @@ class ArenaManager:
         # remove marks we actually processed, so a racing mark survives
         # for the next refresh.
         if "*" in dirty:  # full-store replacement (snapshot restore)
+            self._inval_gen_star += 1  # in-flight builds must re-peek
             if self.hop_cache is not None:
                 self.hop_cache.clear()
             self._data.clear()
@@ -1237,6 +1278,10 @@ class ArenaManager:
             # consumed WITH the journal — a stale base must never
             # re-key a later window's entries
             base = bases.pop(p, None)
+            # consuming this window invalidates any build mid-peek for
+            # the predicate: the delta can't reach an arena that isn't
+            # cached yet, so the builder must re-peek (_get_or_build)
+            self._inval_gen[p] = self._inval_gen.get(p, 0) + 1
             if delta is not None and self._try_apply_delta(p, delta, base):
                 dirty.discard(p)
                 continue
@@ -1390,18 +1435,48 @@ class ArenaManager:
     def sharded_csr(self, pred: str, reverse: bool = False):
         """Row-sharded view of a predicate's CSR over the mesh's 'model'
         axis, cached against the source arena's identity (rebuilds follow
-        the same dirty invalidation as the arena itself)."""
+        the same dirty invalidation as the arena itself) AND the
+        MeshPlan offset it was placed under — a ``rebalance()`` moves a
+        predicate's offset, so its next read rebuilds under the new
+        placement instead of serving the old roll."""
         from dgraph_tpu.parallel.mesh import shard_arena_rows
 
         a = self.reverse(pred) if reverse else self.data(pred)
+        pkey = ("~" + pred) if reverse else pred
 
         def build():
             n_model = self.mesh.shape["model"]
-            return (a, shard_arena_rows(a.h_src, a.h_offsets, a.host_dst(), n_model))
+            sa = shard_arena_rows(
+                a.h_src, a.h_offsets, a.host_dst(), n_model
+            )
+            off = 0
+            if self.mesh_plan is not None:
+                sa = self.mesh_plan.placed(pkey, sa)
+                off = self.mesh_plan.placement.get(pkey, 0)
+            return (a, sa, off)
+
+        def valid(e):
+            if e[0] is not a:
+                return False
+            if self.mesh_plan is None:
+                return True
+            return self.mesh_plan.placement.get(pkey, 0) == e[2]
 
         return self._get_or_build(
-            self._sharded, (pred, reverse), build, valid=lambda e: e[0] is a
+            self._sharded, (pred, reverse), build, valid=valid,
+            gen_key=pred,
         )[1]
+
+    def mesh_executor(self):
+        """The memoized serving-path executor (dgraph_tpu/mesh) over
+        this manager's mesh — None when unsharded."""
+        if self.mesh is None:
+            return None
+        if self._mesh_exec is None:
+            from dgraph_tpu.mesh.executor import MeshExecutor
+
+            self._mesh_exec = MeshExecutor(self)
+        return self._mesh_exec
 
     def use_mesh_for(self, arena: CSRArena) -> bool:
         """Route this arena's expansions through the row-sharded mesh?
@@ -1430,7 +1505,9 @@ class ArenaManager:
 
     def data(self, pred: str) -> CSRArena:
         self.refresh()
-        return self._get_or_build(self._data, pred, lambda: self._build_data(pred))
+        return self._get_or_build(
+            self._data, pred, lambda: self._build_data(pred), gen_key=pred
+        )
 
     def _build_data(self, pred: str) -> CSRArena:
         pd = self.store.peek(pred)
@@ -1448,7 +1525,8 @@ class ArenaManager:
         if pd is None or not pd.values:
             return self.data(pred)
         return self._get_or_build(
-            self._data, pred + "\x00has", lambda: self._build_has(pred)
+            self._data, pred + "\x00has", lambda: self._build_has(pred),
+            gen_key=pred,
         )
 
     def _build_has(self, pred: str) -> CSRArena:
@@ -1460,7 +1538,8 @@ class ArenaManager:
     def reverse(self, pred: str) -> CSRArena:
         self.refresh()
         return self._get_or_build(
-            self._reverse, pred, lambda: self._build_reverse(pred)
+            self._reverse, pred, lambda: self._build_reverse(pred),
+            gen_key=pred,
         )
 
     def _build_reverse(self, pred: str) -> CSRArena:
@@ -1479,6 +1558,7 @@ class ArenaManager:
             self._index,
             (pred, tokenizer),
             lambda: self._build_index(pred, tokenizer),
+            gen_key=pred,
         )
 
     def _build_index(self, pred: str, tokenizer: str) -> IndexArena:
@@ -1518,7 +1598,8 @@ class ArenaManager:
     def values(self, pred: str) -> ValueArena:
         self.refresh()
         return self._get_or_build(
-            self._values, pred, lambda: self._build_values(pred)
+            self._values, pred, lambda: self._build_values(pred),
+            gen_key=pred,
         )
 
     def _build_values(self, pred: str) -> ValueArena:

@@ -313,6 +313,14 @@ def apply_record(store: PostingStore, payload: bytes):
     elif tag == codec.SCHEMA:
         text, _ = codec.get_str(payload, 1)
         parse_schema(text, into=store.schema)
+        # schema semantics (index/reverse/type) change how EVERY
+        # predicate reads: bump the version and the IVM floor exactly
+        # like a live apply_schema, so replica-backed caches (and the
+        # cluster version clock's floor) observe the change
+        store.version += 1
+        note = getattr(store, "note_global_change", None)
+        if note is not None:
+            note()
     elif tag == codec.XID:
         xid, pos = codec.get_str(payload, 1)
         uid, _ = codec.uvarint(payload, pos)

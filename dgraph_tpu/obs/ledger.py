@@ -88,7 +88,7 @@ class Ledger:
         "tenant", "edges", "hops", "host_ms", "device_ms",
         "device_sync_ms", "bytes_h2d", "bytes_d2h", "compiles",
         "cache_hits", "cache_misses", "cache_hit_bytes", "repairs",
-        "coalesced",
+        "coalesced", "exchange_bytes", "mesh_ms", "mesh_chips",
     )
 
     def __init__(self):
@@ -111,6 +111,14 @@ class Ledger:
         self.cache_hit_bytes = 0
         self.repairs = 0
         self.coalesced = 0
+        # mesh serving plane (PR 17): wall time inside mesh programs,
+        # the model-axis width those programs ran on (per-chip device
+        # time = mesh_ms on EVERY chip under SPMD — aggregate chip-time
+        # is mesh_ms × mesh_chips), and the estimated cross-chip
+        # exchange payload (all_gather/psum traffic) they moved
+        self.exchange_bytes = 0
+        self.mesh_ms = 0.0
+        self.mesh_chips = 0
 
     # -- instrumentation sites (callers checked current() is not None) ------
 
@@ -158,8 +166,11 @@ class Ledger:
 
     def to_dict(self) -> dict:
         """The response-extension / span-attr rendering (stable keys,
-        ms rounded — this is an operator surface, not a wire format)."""
-        return {
+        ms rounded — this is an operator surface, not a wire format).
+        Mesh attribution keys appear only when a mesh program actually
+        ran this request — unsharded serving renders the PR-16 dict
+        unchanged."""
+        d = {
             "edges": self.edges,
             "hops": dict(self.hops),
             "host_ms": round(self.host_ms, 3),
@@ -174,6 +185,11 @@ class Ledger:
             "repairs": self.repairs,
             "coalesced": self.coalesced,
         }
+        if self.mesh_chips:
+            d["mesh_ms"] = round(self.mesh_ms, 3)
+            d["mesh_chips"] = self.mesh_chips
+            d["exchange_bytes"] = self.exchange_bytes
+        return d
 
 
 # bounded free list: under the scheduler's worker model at most
@@ -230,12 +246,23 @@ def finish(led: Ledger) -> dict:
         LEDGER_STAGE_US.add("device", int(led.device_ms * 1e3))
     if led.device_sync_ms:
         LEDGER_STAGE_US.add("device_sync", int(led.device_sync_ms * 1e3))
+    if led.mesh_ms:
+        # per-chip attribution: under SPMD every chip runs the program
+        # for its full wall time, so "mesh" is the wall clock and
+        # "mesh_chip" the aggregate chip-time (wall × width) — the
+        # number capacity planning divides HBM-seconds by
+        LEDGER_STAGE_US.add("mesh", int(led.mesh_ms * 1e3))
+        LEDGER_STAGE_US.add(
+            "mesh_chip", int(led.mesh_ms * 1e3) * max(1, led.mesh_chips)
+        )
     if led.bytes_h2d:
         LEDGER_BYTES.add("h2d", led.bytes_h2d)
     if led.bytes_d2h:
         LEDGER_BYTES.add("d2h", led.bytes_d2h)
     if led.cache_hit_bytes:
         LEDGER_BYTES.add("cache_hit", led.cache_hit_bytes)
+    if led.exchange_bytes:
+        LEDGER_BYTES.add("exchange", led.exchange_bytes)
     led.reset()
     with _pool_lock:
         if len(_pool) < _POOL_CAP:

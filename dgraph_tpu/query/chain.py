@@ -348,6 +348,17 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
     levels = collect_chain(engine, child)
     if len(levels) < 2:
         return reject("chain shorter than 2 levels")
+    # --- fused mesh multi-hop (dgraph_tpu/mesh) ---
+    # Shard-eligible arenas truncate the staged chain below (their
+    # levels then re-plan one hop at a time over the mesh, a host round
+    # trip per level).  A light same-predicate undecorated chain on such
+    # an arena instead runs as ONE compiled mesh program whose
+    # cross-chip frontier exchange happens between scan levels on the
+    # interconnect (mesh/programs.py) — the sharded twin of the
+    # _try_chain_scan path.
+    got = _try_mesh_chain(engine, levels, src, reject)
+    if got is not None:
+        return got
     arenas = []
     universe = 0
     for sg in levels:
@@ -676,6 +687,109 @@ def _try_chain_scan(engine, levels, arena, src, est_edges, universe) -> bool:
         dest = fs[i][fs[i] != SENT].astype(np.int64)
         sg.chain_stash = ("light", dest, src_list, int(totals[i]))
         src_list = dest
+    return True
+
+
+def _try_mesh_chain(engine, levels, src, reject):
+    """Fused multi-hop over the mesh serving plane (dgraph_tpu/mesh)
+    for light same-predicate undecorated chains on a SHARD-ELIGIBLE
+    arena — the sharded twin of ``_try_chain_scan``.
+
+    Tri-state return: ``True`` the chain ran and every level is
+    stashed; ``False`` the planner's calibrated verdict was per-level
+    (recorded + rejected, the caller stops fusing); ``None`` this chain
+    is not mesh-fusable (decorated, mixed-predicate, capacity blown, or
+    a chip fault hot-declined) — the caller falls through to the staged
+    path, whose arena loop truncates at the mesh arena and re-plans
+    those levels one hop at a time."""
+    ex = engine.arenas.mesh_executor()
+    if ex is None:
+        return None
+    first = levels[0]
+    attr, rev = first.attr, bool(first.reverse)
+    if any(
+        sg.attr != attr or bool(sg.reverse) != rev for sg in levels
+    ):
+        return None
+    if any(sg.filter is not None for sg in levels):
+        return None
+    if any(
+        sg.params.cascade
+        or sg.params.order_attr
+        or sg.params.first
+        or sg.params.offset
+        for sg in levels
+    ):
+        return None
+    # var blocks only (result matrices never leave the device) + the
+    # fused-executor kill switch, exactly like the unsharded scan gate
+    if not getattr(engine, "_cur_block_internal", False):
+        return None
+    if getattr(engine.expander, "fused_hop", "0") == "0":
+        return None
+    a = engine.arenas.reverse(attr) if rev else engine.arenas.data(attr)
+    if a.n_edges == 0 or not engine.arenas.use_mesh_for(a):
+        return None
+    if not ex.allowed():
+        return None
+    src = np.asarray(src)
+    # capacity planning: one uniform carry shape for every hop, planned
+    # from the worst level (the _try_chain_scan discipline)
+    rows0 = a.rows_for_uids_host(src)
+    est_edges = int(a.degree_of_rows(rows0).sum())
+    caps = [est_edges]
+    m = min(est_edges, max(1, a.n_distinct_dst()))
+    for _ in levels[1:]:
+        e = _topm_deg_sum(a, m)
+        caps.append(e)
+        m = min(e, max(1, a.n_distinct_dst()))
+    cap = ops.bucket(max(max(caps), len(src), 1))
+    if cap > CHAIN_MAX_CAPC_LIGHT * ops.CHUNK:
+        return None
+    # the calibrated fuse-vs-per-level verdict (same gate as the staged
+    # path; est_total propagates by average out-degree, lines above)
+    est_total = est_u = est_edges
+    for _ in levels[1:]:
+        est_u = min(est_u, a.n_rows)
+        lvl = int(est_u * (a.n_edges / max(1, a.n_rows)))
+        est_total += lvl
+        est_u = lvl
+    from dgraph_tpu.query import planner
+
+    fuse, plan_dec = planner.chain_route(engine, est_total, len(levels))
+    if not fuse:
+        if plan_dec is not None:
+            planner.record(engine.stats, plan_dec)
+            return reject(
+                f"fan-out estimate {est_total}: calibrated model favors "
+                f"per-level ({plan_dec['est_other_us']}us fused vs "
+                f"{plan_dec['est_chosen_us']}us per-level)"
+            )
+        return reject(
+            f"fan-out estimate {est_total} below threshold "
+            f"{engine.chain_threshold}"
+        )
+    from dgraph_tpu.utils import devguard
+
+    try:
+        fs, totals = ex.multi_hop(
+            attr, rev, src, len(levels), cap, engine.stats
+        )
+    except devguard.DeviceFaultError:
+        # chip loss / wedged collective: hot-decline the fused program —
+        # the staged path truncates at this arena and its levels re-plan
+        # unsharded (the PR 15 degrade path, now on the chain too)
+        return None
+    src_list = np.asarray(src, dtype=np.int64)
+    for i, sg in enumerate(levels):
+        sg.chain_filtered = False
+        sg.chain_ordered = False
+        dest = fs[i][fs[i] != SENT].astype(np.int64)
+        sg.chain_stash = ("light", dest, src_list, int(totals[i]))
+        src_list = dest
+    if plan_dec is not None:
+        planner.record(engine.stats, plan_dec)
+    engine._pending_chain_dec = plan_dec
     return True
 
 

@@ -396,41 +396,37 @@ class DeviceExpander:
         eng.stats["edges"] += len(out)
         return out, seg_ptr
 
-    def _mesh_expand(self, arena, src, attr, reverse, cap):
-        """Sharded expansion under the "mesh" fault domain.  Returns
-        (out, seg_ptr), or None when the mesh is latched sick or a chip
-        fault/wedged collective was classified — the caller then
-        re-plans this level unsharded (single-device or host), so a
-        lost mesh chip degrades one route, not the node."""
+    def _mesh_expand(self, arena, src, attr, reverse, cap, total):
+        """Sharded expansion under the "mesh" fault domain, dispatched
+        through the mesh serving plane (dgraph_tpu/mesh::MeshExecutor —
+        the executor carries the ledger's per-chip/exchange attribution
+        and the devguard bracket).  Returns (out, seg_ptr), or None
+        when the mesh is latched sick or a chip fault/wedged collective
+        was classified — the caller then re-plans this level unsharded
+        (single-device or host), so a lost mesh chip degrades one
+        route, not the node."""
         eng = self.engine
-        mg = devguard.get("mesh")
-        if not mg.allowed():
+        ex = eng.arenas.mesh_executor()
+        if ex is None or not ex.allowed():
             self._count_failover("unsharded")
             return None
-        from dgraph_tpu.parallel.mesh import sharded_expand_segments
-
-        sharded = eng.arenas.sharded_csr(attr, reverse=reverse)
-
-        def _dispatch():
-            with obs.stage(eng.stats, "device_expand_ms"):
-                return sharded_expand_segments(
-                    eng.arenas.mesh, sharded, src, cap
-                )
-
-        if not devguard.enabled():
-            out, seg_ptr = _dispatch()
-        else:
-            try:
-                out, seg_ptr = mg.run("mesh.expand", _dispatch)
-            except devguard.DeviceFaultError:
-                self._count_failover("unsharded")
-                return None
+        # route:mesh is planner-priced: the decision records the mesh
+        # estimate vs the best unsharded alternative and note_outcome
+        # (closed by _expand_one with the measured stage delta) refines
+        # mesh_edge_us / flags mispredicts
+        _, dec = planner.mesh_route(total, ex.width)
+        if dec is not None:
+            planner.record(eng.stats, dec)
+            self._expand_dec = dec
+        try:
+            out, seg_ptr = ex.expand(attr, reverse, src, cap, eng.stats)
+        except devguard.DeviceFaultError:
+            # a failed dispatch is not a rate sample for the mesh route
+            self._expand_dec = None
+            self._count_failover("unsharded")
+            return None
         self._route = "mesh"
         eng.stats["edges"] += len(out)
-        led = _ledger.current()
-        if led is not None:
-            led.bytes_h2d += int(src.nbytes)
-            led.bytes_d2h += int(out.nbytes + seg_ptr.nbytes)
         return out, seg_ptr
 
     def _expand_one_inner(
@@ -460,7 +456,7 @@ class DeviceExpander:
             return _EMPTY, np.zeros(n + 1, dtype=np.int64)
         cap = ops.bucket(total)
         if attr and eng.arenas.use_mesh_for(arena):
-            got = self._mesh_expand(arena, src, attr, reverse, cap)
+            got = self._mesh_expand(arena, src, attr, reverse, cap, total)
             if got is not None:
                 return got
             # mesh chip-loss / wedged collective: fall through — the

@@ -228,6 +228,7 @@ _RATE_KEY = {
     ("expand", "host"): ("host_edge_us", 0.0),
     ("expand", "device"): ("device_edge_us", 1.0),   # minus one dispatch
     ("expand", "resident"): ("resident_edge_us", 1.0),  # PR 16 Pallas tier
+    ("expand", "mesh"): ("mesh_edge_us", 1.0),  # PR 17 sharded mesh plane
     ("kway", "host"): ("host_intersect_us", 0.0),
     ("kway", "device"): ("device_intersect_us", 1.0),
 }
@@ -350,6 +351,46 @@ def expand_route(
         ),
     }
     return use_device, dec
+
+
+def mesh_route(total: int, width: int) -> Tuple[bool, Optional[dict]]:
+    """Price one shard-eligible level's expansion over the mesh
+    (dgraph_tpu/mesh — the route:mesh leaf).
+
+    Eligibility is the OPERATOR'S verdict (ArenaManager.use_mesh_for:
+    mesh present + shard_threshold/crossover policy) and the planner
+    does not overrule it — a shard-eligible arena expands sharded
+    exactly as it has since the mesh kernels landed, which is what
+    keeps ``DGRAPH_TPU_MESH=0`` byte-identity a pure availability
+    toggle with no planner interplay.  What the planner adds is the
+    PRICE: the recorded decision carries the mesh estimate against the
+    best unsharded alternative, ``note_outcome`` refines
+    ``mesh_edge_us`` from the measured dispatch, and the mispredict
+    counters surface arenas where sharding costs more than it saves
+    (the operator's cue to raise the threshold or rebalance).
+
+    Returns (True, dec); dec is None when the planner is off — the
+    static path records nothing, matching every other route."""
+    if not enabled():
+        return True, None
+    r = rates()
+    host_c = r["host_setup_us"] + total * r["host_edge_us"]
+    dev_c = _device_factor() * (r["dispatch_us"] + total * r["device_edge_us"])
+    from dgraph_tpu.utils import devguard as _devguard
+
+    mesh_c = _devguard.cost_factor("mesh") * (
+        r["dispatch_us"] + total * r["mesh_edge_us"]
+    )
+    dec = {
+        "kind": "expand",
+        "route": "mesh",
+        "units": int(total),
+        "width": int(width),
+        "est_chosen_us": round(mesh_c, 1),
+        "est_other_us": round(min(host_c, dev_c), 1),
+        "reason": "shard-eligible arena priced over the mesh",
+    }
+    return True, dec
 
 
 def merge_gate(est_edges: float, configured_min: int) -> bool:
@@ -485,9 +526,15 @@ class CohortController:
       toward base.
     """
 
-    def __init__(self, base_batch: int, base_flush_s: float):
+    def __init__(self, base_batch: int, base_flush_s: float, width: int = 1):
         self.base_batch = max(1, int(base_batch))
-        self.hi_batch = min(self.base_batch * 8, 1024)
+        # mesh serving plane (PR 17): a width-N mesh expands one merged
+        # cohort frontier across N chips, so the adaptive CEILING scales
+        # with the mesh width — the base (and thus the floor and the
+        # idle behavior) stays put, width only raises how far sustained
+        # load may push the cap before the 1024 clamp
+        self.width = max(1, int(width))
+        self.hi_batch = min(self.base_batch * 8 * self.width, 1024)
         self.base_flush_s = float(base_flush_s)
         self.lo_flush_s = self.base_flush_s / 8.0
         self.max_batch = self.base_batch
@@ -526,6 +573,8 @@ class CohortController:
                 "flush_ms": round(self.flush_s * 1e3, 3),
                 "base_batch": self.base_batch,
                 "base_flush_ms": round(self.base_flush_s * 1e3, 3),
+                "mesh_width": self.width,
+                "hi_batch": self.hi_batch,
                 "occupancy_ewma": round(self._occ, 2),
                 "queue_wait_ms_ewma": round(self._wait * 1e3, 3),
                 "service_ms_ewma": round(self._service * 1e3, 3),

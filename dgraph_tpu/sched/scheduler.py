@@ -196,8 +196,18 @@ class CohortScheduler:
             and not _planconfig.overridden("DGRAPH_TPU_SCHED_MAX_BATCH")
             and not _planconfig.overridden("DGRAPH_TPU_SCHED_FLUSH_MS")
         ):
+            # mesh serving plane (PR 17): capacity ceiling scales with
+            # the mesh width — N chips drain one merged cohort frontier,
+            # so sustained load may batch N× harder before the clamp
+            width = 1
+            try:
+                mesh = server.engine.arenas.mesh
+                if mesh is not None:
+                    width = int(mesh.shape["model"])
+            except AttributeError:
+                pass
             self._adaptive = _planner.CohortController(
-                self.max_batch, self.flush_s
+                self.max_batch, self.flush_s, width=width
             )
         n_workers = int(
             concurrency

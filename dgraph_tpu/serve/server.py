@@ -106,9 +106,17 @@ class DgraphServer:
                 from dgraph_tpu.utils.metrics import note_swallowed
 
                 note_swallowed("server.planner_boot", e)
+        import os as _os
+
         self.engine = QueryEngine(
             store,
             mesh=_auto_mesh(),
+            # mesh placement/eligibility knob (docs/deploy.md "Mesh
+            # serving"): rows at/above this shard over the model axis;
+            # the default matches the engine's, so unset is unchanged
+            shard_threshold=int(
+                _os.environ.get("DGRAPH_TPU_MESH_SHARD_ROWS", "4096")
+            ),
             arena_budget_bytes=(arena_budget_mb * (1 << 20)) or None,
         )
         self.health = HealthGate()
@@ -164,6 +172,11 @@ class DgraphServer:
         if (
             _ivm.ivm_enabled()
             and getattr(store, "pred_versions", None) is not None
+            # ClusterStore exposes pred_versions for per-predicate
+            # cache keying (PR 17) but has no local mutation path to
+            # journal — it must not grow a delta stream or serve
+            # subscriptions (supports_ivm_stream = False there)
+            and getattr(store, "supports_ivm_stream", True)
         ):
             stream = _ivm.attach_stream(store)
             from dgraph_tpu.ivm import subs as _subs
@@ -550,16 +563,26 @@ class DgraphServer:
 
 
 def _auto_mesh():
-    """A ("data","model") mesh over all local devices when more than one
-    is visible (TPU pod slice / virtual CPU mesh); big predicates then
-    expand row-sharded.  DGRAPH_TPU_MESH=off disables."""
+    """A ("data","model") mesh over all local devices; big predicates
+    then expand row-sharded through the mesh serving plane
+    (dgraph_tpu/mesh).
+
+    ``DGRAPH_TPU_MESH`` tri-state (the env convention of planconfig):
+      "0"/"off"       — never: unsharded serving, byte-identical to the
+                        pre-mesh engine (the docs/deploy.md contract);
+      "1"/"auto"/unset — on when more than one device is visible;
+      "force"          — always, even single-device (a 1-wide mesh:
+                        the mesh code paths run, results unchanged —
+                        the CI byte-identity arm uses this with the
+                        forced 8-device host platform)."""
     import os
 
-    if os.environ.get("DGRAPH_TPU_MESH", "auto") == "off":
+    mode = os.environ.get("DGRAPH_TPU_MESH", "auto")
+    if mode in ("0", "off"):
         return None
     import jax
 
-    if len(jax.devices()) < 2:
+    if mode != "force" and len(jax.devices()) < 2:
         return None
     from dgraph_tpu.parallel import make_mesh
 

@@ -59,6 +59,15 @@ class Calibration:
                                      # dispatch (the prior encodes the
                                      # missing term, not a faster ALU;
                                      # online refinement converges it)
+    mesh_edge_us: float = 0.008      # per-edge WALL rate of the
+                                     # row-sharded mesh expansion
+                                     # (dgraph_tpu/mesh): below
+                                     # device_edge_us because N chips
+                                     # split the gather, above the ideal
+                                     # device_edge_us/N because the
+                                     # cross-chip exchange rides every
+                                     # hop; online refinement converges
+                                     # it to the live mesh's reality
     host_edge_us: float = 0.032      # per-edge host numpy gather rate
     host_touch_us: float = 0.010     # per-edge host conversion/dedup the
                                      # per-level path pays that a fused
@@ -79,7 +88,7 @@ class Calibration:
 
     _RATE_FIELDS = (
         "dispatch_us", "device_edge_us", "resident_edge_us",
-        "host_edge_us", "host_touch_us",
+        "mesh_edge_us", "host_edge_us", "host_touch_us",
         "host_setup_us", "chain_plan_us", "host_intersect_us",
         "device_intersect_us", "tile_mac_us", "combine_us_per_mac",
         "tile_build_us_per_lane", "tile_build_amortize",

@@ -903,6 +903,10 @@ _CLI_BAD = {
         "    ra = arena.resident()\n"
         "    return np.asarray(ra.dst)\n"
     ),
+    "naked-collective": (
+        "import jax\n\n"
+        'def f(t):\n    return jax.lax.psum(t, "model")\n'
+    ),
 }
 
 
@@ -1341,6 +1345,82 @@ def test_unregistered_program_factory_counterexamples_clean():
         ) == []
     finally:
         UnregisteredProgramFactory.coverage_override = None
+
+
+def test_naked_collective_flagged_outside_mesh_dirs():
+    """Golden-bad: every collective spelling (module-dotted, lax-dotted,
+    bare import) outside dgraph_tpu/mesh/ and dgraph_tpu/parallel/ is
+    flagged — cross-chip exchange grown in the engine layers ships no
+    placement invariance, no exchange-bytes attribution, no contract."""
+    from dgraph_tpu.analysis.rules import NakedCollective
+
+    bad = textwrap.dedent("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def hop(mesh, f):
+            fn = shard_map(lambda x: x, mesh=mesh, in_specs=(P(),),
+                           out_specs=P())
+            return fn(f)
+
+        def combine(t):
+            g = jax.lax.all_gather(t, "model")
+            s = jax.lax.psum(t, "model")
+            return jax.lax.ppermute(g, "model", [(0, 1)]), s
+    """)
+    found = check_source(
+        bad, [NakedCollective()], path="dgraph_tpu/query/engine.py"
+    )
+    assert _ids(found) == ["naked-collective"] * 4
+    names = {f.message.split("`")[1] for f in found}
+    assert names == {
+        "shard_map", "jax.lax.all_gather", "jax.lax.psum",
+        "jax.lax.ppermute",
+    }
+
+
+def test_naked_collective_counterexamples_clean():
+    """The sanctioned homes are exempt; collective-free mesh USAGE
+    (calling a built step, reading mesh.shape) is clean anywhere; the
+    pragma escape hatch carries the WHY."""
+    from dgraph_tpu.analysis.rules import NakedCollective
+
+    homed = textwrap.dedent("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def step(mesh, t):
+            fn = shard_map(lambda x: x, mesh=mesh, in_specs=(),
+                           out_specs=())
+            return jax.lax.psum(t, "model")
+    """)
+    for home in (
+        "dgraph_tpu/mesh/programs.py", "dgraph_tpu/parallel/mesh.py"
+    ):
+        assert check_source(homed, [NakedCollective()], path=home) == []
+    usage = textwrap.dedent("""
+        from dgraph_tpu.mesh.programs import mesh_multi_hop_step
+
+        def run(mesh, sa, f, cap, hops):
+            step = mesh_multi_hop_step(mesh, cap, hops)
+            width = int(mesh.shape["model"])
+            return step(sa.src, sa.offsets, sa.dst, f), width
+    """)
+    assert check_source(
+        usage, [NakedCollective()], path="dgraph_tpu/query/chain.py"
+    ) == []
+    pragmad = textwrap.dedent("""
+        import jax
+
+        def debug_sum(t):
+            # offline mesh-debug harness, never on the serving path
+            # graftlint: ignore[naked-collective]
+            return jax.lax.psum(t, "model")
+    """)
+    assert check_source(
+        pragmad, [NakedCollective()], path="dgraph_tpu/utils/meshdbg.py"
+    ) == []
 
 
 def test_program_factory_live_coverage_names_real_sites():

@@ -207,6 +207,49 @@ def test_pred_versions_are_per_predicate(placed):
     assert _wait(lambda: fetch_city_ver(ver)[0] == 200)
 
 
+def test_cluster_version_for_unmasked_across_groups(placed):
+    """PR 17 regression: ClusterStore's per-pred cache versions must
+    compose across groups.  Raft indices from different groups share no
+    scale — a naive max-over-indices lets group 2's long log mask a
+    fresh write to a group-1 predicate, and the footprint version would
+    never advance (stale cache served forever).  The cluster clock
+    (service._PredVersionClock) must advance on the low-indexed group's
+    write anyway."""
+    from dgraph_tpu.ivm.versions import version_for
+
+    _load(placed)
+    st = placed[0].store
+    q = '{ q(func: eq(name, "ann")) { lives_in { city } } }'
+    # warm server 1's remote snapshot cache for the group-2 predicate
+    _wait(lambda: _post(placed[0].addr, "/query", q).get("q"))
+    # inflate group 2's raft log well past group 1's apply index
+    for i in range(10):
+        _post(
+            placed[1].addr, "/query",
+            f"mutation {{ set {{ <0x{0x20 + i:x}> <lives_in> <0x10> . }} }}",
+        )
+    time.sleep(0.1)
+    _post(placed[0].addr, "/query", q)  # TTL probe observes the bump
+    fp = {"name", "lives_in"}
+    v1 = version_for(st, fp)
+    # stable while nothing changes (the clock must not mint fresh ticks
+    # for predicates whose source version is unchanged)
+    assert version_for(st, fp) == v1
+    # the masking case: a write to the group whose raft index is far
+    # BEHIND group 2's must still advance the footprint version
+    _post(placed[0].addr, "/query", 'mutation { set { <0x5> <name> "eve" . } }')
+    assert _wait(lambda: version_for(st, fp) > v1)
+    # scoping still holds on the cluster clock: the name write leaves
+    # a name-free footprint's version alone...
+    v_city = version_for(st, {"city"})
+    assert version_for(st, {"city"}) == v_city
+    # ...and a schema change (non-scopeable) lifts the floor for
+    # every footprint
+    _post(placed[0].addr, "/query",
+          "mutation { schema { nick: string . } }")
+    assert _wait(lambda: version_for(st, {"city"}) > v_city)
+
+
 def test_predicates_fetch_does_not_hold_remote_lock():
     """ADVICE r3 (medium): ClusterStore.predicates() must not hold
     _remote_lock across the (possibly 5s-timeout) fetch_predlist network
