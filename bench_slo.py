@@ -45,6 +45,9 @@ Knobs (env, all sized for the 2-core CI host by default):
   SLO_VICTIM_RATE    victim offered load, qps (10)
   SLO_IVM_RATE       fixed read load for the ivm arm (50)
   SLO_IVM_WRITE_RATES  write-rate sweep, writes/s CSV ("0,10,25")
+  SLO_SEG            run the segmented-execution arm (1)
+  SLO_SEG_VICTIM_RATE / SLO_SEG_ANTAG_RATE  seg-arm offered loads (10 / 8)
+  SLO_SEG_DELAY_MS   injected per-dispatch device time for the seg arm (80)
   SLO_SEED           RNG seed (7)
   SLO_OUT            also write the JSON to this path
   --backend mesh     (or SLO_BACKEND=mesh) force the mesh serving plane
@@ -705,6 +708,226 @@ def run_devfault_arm(store, rates, secs, workers, seed) -> dict:
     return out
 
 
+# every device dispatch seam the mega-query may route through: the
+# planner picks chain vs mask-chain vs multi-hop per store shape, and
+# the arm must price the dispatch wherever it lands
+_SEG_SITES = ("device.chain", "device.spgemm", "device.multi_hop")
+
+
+def _seg_cancel_probe(port: int, body: str, tid_int: int) -> dict:
+    """Fire one mega-query with a sampled traceparent, /admin/cancel it
+    the moment the registry has the token (the query is live), and
+    report the wall time from cancel-ack to response completion — the
+    observed cancellation latency.  Segmented, the token check at the
+    next seam bounds it to ~one segment (499); monolithic, the program
+    runs to completion first (200)."""
+    from dgraph_tpu.utils.failpoints import fail
+
+    tp = "00-%032x-%016x-01" % (tid_int, tid_int)
+    res: dict = {}
+    base_hits = sum(fail.hits(s) for s in _SEG_SITES)
+
+    def runner():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        try:
+            conn.request(
+                "POST", "/query", body=body.encode(),
+                headers={"Traceparent": tp, "X-Dgraph-Tenant": "antagonist"},
+            )
+            r = conn.getresponse()
+            r.read()
+            res["status"] = r.status
+        except OSError:
+            res["status"] = -1
+        finally:
+            res["done_at"] = time.monotonic()
+            conn.close()
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    # cancelling a QUEUED query measures the pre-run fast path, not the
+    # mid-chain latency under test: hold the cancel until the query's
+    # first device dispatch fires (the probe runs alone, so the hit
+    # delta is attributable)
+    deadline = time.monotonic() + 30.0
+    while (time.monotonic() < deadline and t.is_alive()
+           and sum(fail.hits(s) for s in _SEG_SITES) == base_hits):
+        time.sleep(0.002)
+    cancel_at = None
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        while time.monotonic() < deadline and t.is_alive():
+            conn.request("GET", "/admin/cancel?trace_id=%032x" % tid_int)
+            r = conn.getresponse()
+            r.read()
+            if r.status == 200:
+                cancel_at = time.monotonic()
+                break
+            time.sleep(0.02)  # 404: not admitted yet
+    finally:
+        conn.close()
+    t.join(timeout=120)
+    if cancel_at is None or "done_at" not in res:
+        return {"error": "cancel never landed on a live query"}
+    return {
+        "status": res.get("status"),
+        "cancel_to_done_ms": round((res["done_at"] - cancel_at) * 1e3, 1),
+    }
+
+
+def run_seg_arm(store, secs, workers, seed) -> dict:
+    """Victim p999 under a MEGA-QUERY antagonist, segmentation on vs
+    off — the PR-18 A/B.  The antagonist sends deep light (var-block)
+    chains — 6 uid levels, pinned onto the fused mask-chain driver via
+    DGRAPH_TPU_MXU_JOIN=force + the static chain-gate override, so the
+    route never wobbles mid-arm — whose per-dispatch device time is
+    injected at the ``device.spgemm`` failpoint with EQUAL total work
+    per query in both modes: segmented (k=1) pays delay_ms at each of
+    the 6 segment dispatches, monolithic pays 6×delay_ms at its single
+    dispatch.  (A materialized 6-deep chain would be a response-encode
+    bomb — deg^6 nested output nodes; the var-block shape is the real
+    mega-query: all device work, tiny response.)
+    The victim is a critical-priority point-read tenant: with
+    segmentation on, a queued victim cohort preempts the running
+    antagonist at the next seam (dgraph_segment_preempt_us records the
+    wait), so its p999 is bounded by ~one segment; off, it waits out
+    whole programs.  A mid-flight /admin/cancel probe per mode measures
+    the cancellation latency the same way."""
+    from dgraph_tpu import obs
+    from dgraph_tpu.utils.failpoints import fail
+    from dgraph_tpu.utils.metrics import SEGMENT_PREEMPT_US
+
+    # a hair of head sampling so the cancel probe's SAMPLED traceparent
+    # joins (the process recorder was built with ratio 0, under which
+    # nothing joins and /admin/cancel can target nothing); restored to
+    # the env default in the finally
+    obs.configure(ratio=1e-9)
+
+    victim_rate = _env_f("SLO_SEG_VICTIM_RATE", 10.0)
+    antag_rate = _env_f("SLO_SEG_ANTAG_RATE", 8.0)
+    delay_ms = _env_f("SLO_SEG_DELAY_MS", 80.0)
+    levels = 6
+    total_ms = delay_ms * levels
+    rng = np.random.default_rng(seed + 7000)
+    n_nodes = int(_env_f("SLO_NODES", 20_000))
+    victim_pool = [
+        "{ q(func: uid(0x%x)) { uid } }" % u
+        for u in np.unique(rng.integers(1, n_nodes + 1, size=64))
+    ]
+    body = "v as e"
+    for _ in range(levels - 1):
+        body = "e { %s }" % body
+    antag_pool = []
+    for _ in range(32):
+        seeds = np.unique(rng.integers(1, n_nodes + 1, size=8))
+        ul = ", ".join("0x%x" % u for u in seeds)
+        antag_pool.append(
+            "{ var(func: uid(%s)) { %s } "
+            "q(func: uid(v), first: 1) { uid } }" % (ul, body)
+        )
+    tenants = json.dumps({
+        "victim": {"weight": 8, "priority": "critical"},
+        "antagonist": {"weight": 1, "max_queued": 16,
+                       "priority": "standard"},
+    })
+    out = {
+        "victim_offered_qps": victim_rate,
+        "antagonist_offered_qps": antag_rate,
+        "delay_ms": delay_ms,
+        "levels": levels,
+        "total_injected_ms": total_ms,
+        "tenants": json.loads(tenants),
+    }
+    fp_seed = int(os.environ.get("DGRAPH_TPU_FAILPOINT_SEED", "0"))
+    try:
+        _run_seg_modes(
+            store, secs, workers, seed, out, fp_seed,
+            victim_pool, antag_pool, tenants, delay_ms, total_ms,
+            victim_rate, antag_rate,
+        )
+    finally:
+        obs.configure()  # back to the env-default recorder
+    return out
+
+
+def _run_seg_modes(
+    store, secs, workers, seed, out, fp_seed,
+    victim_pool, antag_pool, tenants, delay_ms, total_ms,
+    victim_rate, antag_rate,
+) -> None:
+    from dgraph_tpu.utils.failpoints import fail
+    from dgraph_tpu.utils.metrics import SEGMENT_PREEMPT_US
+
+    for mode, seg_env, per_dispatch_ms in (
+        ("seg_on",
+         {"DGRAPH_TPU_SEGMENT": "force", "DGRAPH_TPU_SEGMENT_K": "1"},
+         delay_ms),
+        ("seg_off", {"DGRAPH_TPU_SEGMENT": "0"}, total_ms),
+    ):
+        fail.reset(fp_seed)
+        with _ServerArm(store, {
+            "DGRAPH_TPU_SCHED": "1",
+            # a cached mega-query stresses nothing; and cached chains
+            # dodge the dispatch seam the arm must measure
+            "DGRAPH_TPU_CACHE": "0",
+            "DGRAPH_TPU_QOS": "1",
+            "DGRAPH_TPU_QOS_TENANTS": tenants,
+            # pin the deep chain onto the fused mask-chain driver (env
+            # override = static gate; the planner yields the decision)
+            "DGRAPH_TPU_CHAIN_THRESHOLD": "1",
+            "DGRAPH_TPU_MXU_JOIN": "force",
+            # one flush worker: the victim must actually queue behind
+            # the running mega-query — with a second worker free the
+            # A/B measures nothing
+            "DGRAPH_TPU_SCHED_CONCURRENCY": "1",
+            **seg_env,
+            **_backend_env(),
+        }) as srv:
+            classes = [
+                {"name": "victim", "rate": victim_rate,
+                 "pool": victim_pool, "tenant": "victim"},
+                {"name": "antagonist", "rate": antag_rate,
+                 "pool": antag_pool, "tenant": "antagonist"},
+            ]
+            _warmup(srv.port, classes)
+            p0 = SEGMENT_PREEMPT_US.count()
+            # arm AFTER warmup: compiles are slow, not under test.  The
+            # delay prices each device dispatch, whichever driver the
+            # planner routes the chain to (chain / mask-chain /
+            # multi-hop); victims are point lookups on the host route
+            # and never pay it.
+            for site in _SEG_SITES:
+                fail.arm(site, f"delay(ms={per_dispatch_ms:g})")
+            try:
+                step = open_loop_step(
+                    srv.port, classes, secs, seed + 7000, workers
+                )
+                cancel = _seg_cancel_probe(
+                    srv.port, antag_pool[0],
+                    0x5E60 + (1 if mode == "seg_on" else 2),
+                )
+            finally:
+                fail.reset(fp_seed)
+            v = step["classes"]["victim"]
+            a = step["classes"]["antagonist"]
+            out[mode] = {
+                "victim_p50_ms": v["p50_ms"],
+                "victim_p99_ms": v["p99_ms"],
+                "victim_p999_ms": v["p999_ms"],
+                "victim_ok": v["ok"],
+                "antagonist_ok": a["ok"],
+                "antagonist_shed": a["shed"],
+                "preempts": SEGMENT_PREEMPT_US.count() - p0,
+                "cancel": cancel,
+            }
+            print(
+                f"# slo seg[{mode}] victim_p999={v['p999_ms']}ms "
+                f"preempts={out[mode]['preempts']} "
+                f"cancel={cancel}",
+                file=sys.stderr,
+            )
+
+
 # ------------------------------------------------------------------ main
 
 def run_slo_bench() -> dict:
@@ -749,6 +972,12 @@ def run_slo_bench() -> dict:
             )
         except Exception as e:
             devfault = {"error": f"{type(e).__name__}: {e}"}
+    seg = None
+    if os.environ.get("SLO_SEG", "1") != "0":
+        try:
+            seg = run_seg_arm(store, secs, workers, seed)
+        except Exception as e:
+            seg = {"error": f"{type(e).__name__}: {e}"}
 
     from dgraph_tpu.obs import ledger as _ledgermod
 
@@ -768,6 +997,7 @@ def run_slo_bench() -> dict:
         "qos": qos,
         "ivm": ivm,
         "devfault": devfault,
+        "seg": seg,
         # the serving-path cost account for the whole run (obs/ledger.py):
         # edges/sec across the sweep is achieved_qps × edges-per-query,
         # and this is the series it reconciles against
@@ -824,6 +1054,38 @@ def smoke_check(out: dict) -> None:
         assert inj_off["p999_ms"] >= dv["wedge_ms"] * 0.6, (
             "devfault smoke: guard-off arm never observed the wedge"
         )
+    sg = out.get("seg")
+    if sg and "error" not in sg:
+        on, off = sg["seg_on"], sg["seg_off"]
+        total = sg["total_injected_ms"]
+        # structural separation: with segmentation on the critical
+        # victim preempts at seams (p999 bounded under one program);
+        # off, it waits out whole monolithic programs
+        assert on["preempts"] > 0, (
+            "seg smoke: segmentation never drove a preemption"
+        )
+        assert on["victim_p999_ms"] < total, (
+            f"seg smoke: victim p999 not bounded with segmentation on "
+            f"({on['victim_p999_ms']}ms vs program {total}ms)"
+        )
+        assert off["victim_p999_ms"] >= total * 0.6, (
+            "seg smoke: monolithic arm never made the victim wait"
+        )
+        assert on["victim_p999_ms"] < off["victim_p999_ms"], (
+            f"seg smoke: victim p999 did not improve "
+            f"({on['victim_p999_ms']}ms on vs {off['victim_p999_ms']}ms off)"
+        )
+        con, coff = on["cancel"], off["cancel"]
+        if "error" not in con and "error" not in coff:
+            # mid-chain cancel completes within ~one segment (3x slack
+            # for CI scheduling noise) vs the monolithic remainder
+            assert con["cancel_to_done_ms"] < sg["delay_ms"] * 3, (
+                f"seg smoke: cancel latency not segment-bounded "
+                f"({con['cancel_to_done_ms']}ms)"
+            )
+            assert con["cancel_to_done_ms"] < coff["cancel_to_done_ms"], (
+                "seg smoke: segmentation did not shorten cancel latency"
+            )
 
 
 def main() -> None:

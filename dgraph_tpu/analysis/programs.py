@@ -911,6 +911,21 @@ def _b_multi_hop() -> List[ProgramInstance]:
             (offsets, dst, f, vis),
             {"n_hops": 3, "cap": 32, "track_visited": True, "lut": lut},
         ),
+        # PR 18 segmented variants: the per-segment program the
+        # segment loop dispatches at k=1 — the same _multi_hop_jit
+        # bucketed on n_hops, so the bucket key stays sound over k and
+        # the donated carry contract holds segment-to-segment.
+        ProgramInstance(
+            "H1xC32_seg", batch._multi_hop_jit,
+            (offsets, dst, f, vis),
+            {"n_hops": 1, "cap": 32, "track_visited": False, "lut": None},
+            donate_unused_ok=(3,),
+        ),
+        ProgramInstance(
+            "H1xC32_seg_visited", batch._multi_hop_jit,
+            (offsets, dst, f, vis),
+            {"n_hops": 1, "cap": 32, "track_visited": True, "lut": lut},
+        ),
     ]
 
 
@@ -943,6 +958,13 @@ def _b_mesh_multi_hop() -> List[ProgramInstance]:
         ProgramInstance(
             "H3xC64", mesh_multi_hop_step(mesh, 64, 3),
             (sa.src, sa.offsets, sa.dst, f64), {},
+        ),
+        # PR 18 segmented variant: the one-hop step the mesh segment
+        # loop dispatches at k=1 (mesh_multi_hop_step's lru_cache
+        # bounds the per-k executables).
+        ProgramInstance(
+            "H1xC32_seg", mesh_multi_hop_step(mesh, 32, 1),
+            (sa.src, sa.offsets, sa.dst, f32), {},
         ),
     ]
 
@@ -1052,6 +1074,13 @@ def _b_mask_chain() -> List[ProgramInstance]:
         ProgramInstance(
             f"L2xM{m}", spgemm.run_mask_chain,
             (ops2, (None, keep), (pt.degs, pt.degs), x0),
+        ),
+        # PR 18 segmented variant: the single-level chain segment the
+        # joinplan segment loop dispatches at k=1, masks threaded
+        # device-resident between segments.
+        ProgramInstance(
+            f"L1xM{m}_seg", spgemm.run_mask_chain,
+            (ops2[:1], (keep,), (pt.degs,), x0),
         ),
     ]
 
@@ -1577,7 +1606,11 @@ EXEMPT_SITES: Dict[str, str] = {
         "composite of registered kernels (expand_inline_seg, "
         "gather_ranks, segmented_sort_perm) whose static spec tuple "
         "comes from engine planning state; covered end-to-end by "
-        "tests/test_chain.py parity + the compile-budget hook"
+        "tests/test_chain.py parity + the compile-budget hook.  The "
+        "PR 18 segmented grouping (static carry flag + level-slice "
+        "tuples) is the same composite over a level subrange — "
+        "byte-parity with the monolithic call pinned by "
+        "tests/test_segments.py"
     ),
     "dgraph_tpu/parallel/mesh.py::sharded_expand_step": (
         "needs a live device Mesh; byte-parity with the registered "

@@ -944,6 +944,11 @@ _HOP_SEAM_ATTRS = {
     "expand", "_expand", "_expand_rows", "_exec_child",
     "_exec_child_inner", "submit_hop", "multi_hop",
 }
+# segmented dataflow (PR 18): a host loop that re-dispatches a carry
+# through a bounded program segment — by convention every segment
+# driver names its per-segment dispatch helper `_dispatch_segment`
+# (ops/batch.py, query/chain.py, query/joinplan.py, mesh/executor.py)
+_SEG_SEAM_ATTRS = {"_dispatch_segment"}
 _HOP_CHECK_ATTRS = {"checkpoint"}
 
 
@@ -956,6 +961,15 @@ def _is_seam_call(node: ast.AST) -> bool:
     return isinstance(f, ast.Name) and f.id == "expand"
 
 
+def _is_segment_dispatch_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr in _SEG_SEAM_ATTRS
+    return isinstance(f, ast.Name) and f.id in _SEG_SEAM_ATTRS
+
+
 def _is_checkpoint_call(node: ast.AST) -> bool:
     if not isinstance(node, ast.Call):
         return False
@@ -963,6 +977,11 @@ def _is_checkpoint_call(node: ast.AST) -> bool:
     if isinstance(f, ast.Attribute):
         if f.attr in _HOP_CHECK_ATTRS:
             return True
+        # the scheduler yield point between program segments
+        # (sched/segments.py): segments.seam(...) probes the token AND
+        # offers preemption — it IS the checkpoint of a segment loop
+        if f.attr == "seam":
+            return "segment" in _dotted(f).lower()
         # direct token probe: <something>cancel/token<something>.check()
         if f.attr == "check":
             root = _dotted(f).lower()
@@ -973,41 +992,58 @@ def _is_checkpoint_call(node: ast.AST) -> bool:
 class UncheckedHopLoop(Rule):
     id = "unchecked-hop-loop"
     doc = (
-        "loop in query/ driving the expander/dispatch seam without a "
-        "CancelToken checkpoint — cooperative cancellation needs a "
-        "checkpoint in EVERY hop-dispatching loop (engine.checkpoint() "
-        "/ resolver.checkpoint() / <token>.check())"
+        "loop driving the expander/dispatch seam (query/) or "
+        "re-dispatching a segment carry (_dispatch_segment in "
+        "query//ops//mesh/) without a CancelToken checkpoint or "
+        "segments.seam() yield point — cooperative cancellation and "
+        "segment preemption need a probe between EVERY pair of "
+        "dispatches"
     )
 
     # query/ is the layer that drives hop dispatches in loops; ops/
     # loops run INSIDE jitted programs where a checkpoint is impossible
     # by design (the documented cancellation granularity is one
-    # dispatched program), and sched/ owns the token itself.
+    # dispatched program), and sched/ owns the token itself.  The ONE
+    # exception to the ops//mesh/ exemption is the segment driver
+    # (PR 18): its `_dispatch_segment` loop is a HOST loop between
+    # bounded programs — exactly where a yield point is possible and
+    # required — so those calls are checked in all three layers.
     _DIRS = ("query/",)
+    _SEG_DIRS = ("query/", "ops/", "mesh/")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         path = ctx.path.replace("\\", "/")
-        if not any(d in path for d in self._DIRS):
+        hop_layer = any(d in path for d in self._DIRS)
+        seg_layer = any(d in path for d in self._SEG_DIRS)
+        if not hop_layer and not seg_layer:
             return
         for node in ast.walk(ctx.tree):
             if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
                 continue
             has_seam = False
+            has_seg = False
             has_check = False
             for sub in ast.walk(node):
-                if _is_seam_call(sub):
+                if hop_layer and _is_seam_call(sub):
                     has_seam = True
+                elif seg_layer and _is_segment_dispatch_call(sub):
+                    has_seg = True
                 elif _is_checkpoint_call(sub):
                     has_check = True
-            if has_seam and not has_check:
+            if (has_seam or has_seg) and not has_check:
+                what = (
+                    "re-dispatches a program-segment carry"
+                    if has_seg
+                    else "dispatches hop expansions"
+                )
                 yield ctx.finding(
                     self.id, node,
-                    "this loop dispatches hop expansions but never "
-                    "checkpoints the request's CancelToken: a "
-                    "deadline-expired or disconnected query keeps "
-                    "burning engine time here — call engine.checkpoint()"
-                    " (or resolver.checkpoint() / <token>.check()) "
-                    "inside the loop, or pragma the site with the WHY",
+                    f"this loop {what} but never probes the request's "
+                    "CancelToken or yield point: a deadline-expired, "
+                    "disconnected, or preemptable query keeps burning "
+                    "engine time here — call engine.checkpoint() / "
+                    "segments.seam() / <token>.check() between "
+                    "dispatches, or pragma the site with the WHY",
                 )
 
 

@@ -129,9 +129,65 @@ class MeshExecutor:
                 )
                 return np.asarray(fs), np.asarray(totals)
 
+        # segmented dataflow (PR 18): k hops of the mesh scan per
+        # dispatched program, the in-program exchange untouched inside a
+        # segment, the ``final`` frontier output threaded (device-
+        # resident) between segments with a scheduler yield point at
+        # every seam.  mesh_multi_hop_step's lru_cache bounds the
+        # segment programs: fixed k compiles the k-hop step and at most
+        # one remainder per cap bucket.
+        from dgraph_tpu.sched import segments
+
+        seg_k = segments.plan(int(n_hops), cap, "mesh")
+
+        def _dispatch_segment(f, hops):
+            fail.point("device.mesh")
+            sstep = mesh_multi_hop_step(self.mesh, cap, hops)
+            with obs.stage(stats, "chain_ms"):
+                sfs, stot, final = sstep(
+                    sharded.src, sharded.offsets, sharded.dst, f
+                )
+                return np.asarray(sfs), np.asarray(stot), final
+
+        def _run_segmented():
+            fs_parts, tot_parts = [], []
+            f = jnp.asarray(
+                ops.pad_to(np.asarray(src, dtype=np.int64), cap)
+            )
+            done = 0
+            while done < int(n_hops):
+                if done:
+                    segments.seam("mesh")
+                hops = min(seg_k, int(n_hops) - done)
+                mg2 = devguard.get("mesh")
+                if not devguard.enabled():
+                    sfs, stot, f = _dispatch_segment(f, hops)
+                else:
+                    sfs, stot, f = mg2.run(
+                        "mesh.multi_hop",
+                        lambda f=f, hops=hops: _dispatch_segment(f, hops),
+                    )
+                fs_parts.append(sfs)
+                tot_parts.append(stot)
+                done += hops
+                if done < int(n_hops) and sfs[-1][0] == ops.SENT:
+                    # drained frontier: the remaining hops are all-SENT
+                    # rows / zero totals on every chip — synthesize and
+                    # stop dispatching
+                    segments.early_exit("mesh")
+                    r = int(n_hops) - done
+                    fs_parts.append(
+                        np.full((r, cap), ops.SENT, sfs.dtype)
+                    )
+                    tot_parts.append(np.zeros((r,), stot.dtype))
+                    break
+            return np.concatenate(fs_parts), np.concatenate(tot_parts)
+
         t0 = time.perf_counter()
         mg = devguard.get("mesh")
-        if not devguard.enabled():
+        if 0 < seg_k < int(n_hops):
+            fs, totals = _run_segmented()
+        elif not devguard.enabled():
             fs, totals = _dispatch()
         else:
             fs, totals = mg.run("mesh.multi_hop", _dispatch)

@@ -270,7 +270,63 @@ def multi_hop(
     # async dispatch, faults propagate raw — the legacy path); callers
     # (query/chain.py, query/recurse.py) catch DeviceFaultError and
     # fall back to per-level execution
-    return devguard.get().run("device.multi_hop", _dispatch)
+    from dgraph_tpu.sched import segments
+
+    k = segments.plan(n_hops, cap, "multi_hop")
+    if k <= 0 or k >= n_hops:
+        return devguard.get().run("device.multi_hop", _dispatch)
+
+    # segmented dataflow (PR 18): k hops per dispatched program, the
+    # donated (frontier, visited) carry threaded between segments, a
+    # scheduler yield point (cancellation / preemption) at every seam.
+    # Per-hop math is untouched — the stacked per-segment outputs
+    # concatenate to the monolithic result byte-identically.  The
+    # program cache stays bounded: fixed k compiles at most two
+    # executables (the k-hop body and one remainder).
+    def _dispatch_segment(f, vis, hops):
+        fail.point("device.multi_hop")
+        seg_ms = obs.NOOP if sp is None else sp.child("multi_hop_seg")
+        with expected_unusable_donation("ops.batch.multi_hop"), seg_ms:
+            res = _multi_hop_jit(
+                offsets, dst, f, vis, hops, cap, track_visited, lut
+            )
+            if sp is not None:
+                seg_ms.set_attr("hops", int(hops))
+                seg_ms.set_attr("cap", int(cap))
+                seg_ms.set_attr(
+                    "device_sync_ms", round(obs.block_ready_ms(res), 3)
+                )
+            elif devguard.enabled():
+                obs.block_ready_ms(res)
+            return res
+
+    fs_parts, tot_parts = [], []
+    f, vis = frontier, visited
+    done = 0
+    while done < n_hops:
+        if done:
+            segments.seam("multi_hop")
+        hops = min(k, n_hops - done)
+        seg_fs, seg_tot, vis = devguard.get().run(
+            "device.multi_hop",
+            lambda f=f, vis=vis, hops=hops: _dispatch_segment(f, vis, hops),
+        )
+        fs_parts.append(seg_fs)
+        tot_parts.append(seg_tot)
+        done += hops
+        if done < n_hops:
+            f = seg_fs[-1]
+            if bool(f[0] == SENT):
+                # drained frontier: every remaining hop would expand
+                # nothing — synthesize the all-SENT rows / zero totals
+                # the monolithic scan would have produced and stop
+                # dispatching (the carry-accumulation early exit)
+                segments.early_exit("multi_hop")
+                r = n_hops - done
+                fs_parts.append(jnp.full((r, cap), SENT, seg_fs.dtype))
+                tot_parts.append(jnp.zeros((r,), seg_tot.dtype))
+                break
+    return jnp.concatenate(fs_parts), jnp.concatenate(tot_parts), vis
 
 
 @partial(

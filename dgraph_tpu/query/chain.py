@@ -148,8 +148,10 @@ def collect_chain(engine, child) -> List:
     return levels
 
 
-@partial(jax.jit, static_argnames=("caps", "light"))
-def _run_fused(root_vec, metas, ovs, luts, keeps, orders, caps, light=False):
+@partial(jax.jit, static_argnames=("caps", "light", "carry"))
+def _run_fused(
+    root_vec, metas, ovs, luts, keeps, orders, caps, light=False, carry=False
+):
     """One program for the whole chain, ONE packed output buffer.
 
     Round 4: levels expand through the INLINE-HEAD layout
@@ -170,6 +172,11 @@ def _run_fused(root_vec, metas, ovs, luts, keeps, orders, caps, light=False):
       level i+1; order_static_i = None | (desc, offset, first, has_vals).
     light: var-block mode — only edge counts (and consumed frontiers)
       transfer.
+    carry: segmented execution (PR 18) — append the FINAL level's deduped
+      frontier as one extra trailing ``cap_u`` array so the next k-level
+      segment can consume it as its root_vec without a host round trip
+      (light mode drops ``nxt`` from the packed output when nothing on
+      the host needs it; the carry still must thread).
 
     Packed layout per level:
       full undecorated: [inline.ravel | ov.ravel | ovseg | nxt | total]
@@ -257,6 +264,8 @@ def _run_fused(root_vec, metas, ovs, luts, keeps, orders, caps, light=False):
             else:
                 parts += [total.reshape(1)]
         u = nxt
+    if carry:
+        parts.append(u)
     return jnp.concatenate(parts)
 
 
@@ -539,8 +548,59 @@ def try_run_chain(engine, child, src: np.ndarray, resolver=None) -> bool:
             )
         )
 
+    # segmented dataflow (PR 18): k consecutive levels per dispatched
+    # program, the final level's deduped frontier threaded (device-
+    # resident, via the carry tail) as the next segment's root_vec, a
+    # scheduler yield point between dispatches.  Per-level math and the
+    # packed layout are untouched — the concatenated per-segment host
+    # buffers ARE the monolithic packed buffer, so the conversion loop
+    # below never learns segmentation happened.
+    from dgraph_tpu.sched import segments
+
+    seg_k = segments.plan(
+        len(levels), max(1, est_edges // max(1, len(levels))), "chain"
+    )
+
+    def _dispatch_segment(root_vec, lo, hi, want_carry):
+        fail.point("device.chain")
+        metas, ovs, luts = [], [], []
+        for a in arenas[lo:hi]:
+            mp, ov = a.inline_layout()
+            metas.append(mp)
+            ovs.append(ov)
+            luts.append(a.lut(universe))
+        return _run_fused(
+            root_vec, tuple(metas), tuple(ovs), tuple(luts),
+            tuple(keeps[lo:hi]), tuple(orders[lo:hi]),
+            tuple(caps[lo:hi]), light=light, carry=want_carry,
+        )
+
     try:
-        packed = devguard.get().run("device.chain", _dispatch)
+        if seg_k <= 0 or seg_k >= len(levels):
+            packed = devguard.get().run("device.chain", _dispatch)
+        else:
+            host_parts = []
+            root_vec = jnp.asarray(ops.pad_to(src, caps[0][0]))
+            lo = 0
+            while lo < len(levels):
+                if lo:
+                    segments.seam("chain")
+                hi = min(lo + seg_k, len(levels))
+                want_carry = hi < len(levels)
+                dev = devguard.get().run(
+                    "device.chain",
+                    lambda rv=root_vec, lo=lo, hi=hi, wc=want_carry: (
+                        _dispatch_segment(rv, lo, hi, wc)
+                    ),
+                )
+                if want_carry:
+                    tail = caps[hi - 1][2]  # cap_u of the segment-final level
+                    root_vec = dev[-tail:]  # stays device-resident
+                    host_parts.append(np.asarray(dev)[:-tail])
+                else:
+                    host_parts.append(np.asarray(dev))
+                lo = hi
+            packed = np.concatenate(host_parts)
     except devguard.DeviceFaultError:
         return reject("device fault: chain fell back to per-level")
 

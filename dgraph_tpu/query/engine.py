@@ -720,10 +720,21 @@ class QueryEngine:
         hop-dispatch boundaries only — a dispatched device program
         always completes, so cancellation latency is bounded by one
         hop.  The graftlint rule ``unchecked-hop-loop`` enforces a
-        checkpoint in every query/ loop that drives the expander."""
+        checkpoint in every query/ loop that drives the expander.
+
+        Segmented dataflow (PR 18): a checkpoint is also a scheduler
+        yield point — after the token probe it offers the seam to a
+        queued higher-priority cohort (sched/segments.py), so per-level
+        hop loops (ClassedExpander chains) preempt at hop boundaries
+        exactly like the fused drivers preempt at segment seams."""
         tok = self.cancel
         if tok is not None:
             tok.check()
+        from dgraph_tpu.sched import segments as _segments
+
+        ctx = _segments.current()
+        if ctx is not None and ctx.preempt is not None:
+            ctx.preempt()
 
     @property
     def expand_device_min(self) -> int:
@@ -745,6 +756,27 @@ class QueryEngine:
         shared by the embedded path (run) and the HTTP server."""
         self.stats = _fresh_stats()
         self.last_dump = None
+        # segmented dataflow (PR 18): arm the fused drivers' seams for
+        # this request.  A scheduler-installed context contributes the
+        # preempt hook (and the token it registered); with none active
+        # (embedded engines, DGRAPH_TPU_SCHED=0) a token-only context
+        # still bounds mid-chain cancellation to one segment.  Either
+        # way the STATS binding is re-made here — the line above just
+        # replaced the dict the outer context captured.
+        from dgraph_tpu.sched import segments as _segments
+
+        outer = _segments.current()
+        prev = _segments.activate(_segments.SegmentContext(
+            token=outer.token if outer is not None else self.cancel,
+            preempt=outer.preempt if outer is not None else None,
+            stats=self.stats,
+        ))
+        try:
+            return self._run_parsed_inner(parsed)
+        finally:
+            _segments.deactivate(prev)
+
+    def _run_parsed_inner(self, parsed: "gql.ParsedResult") -> dict:
         out: dict = {}
         if parsed.mutation is not None:
             from dgraph_tpu.serve.mutations import (

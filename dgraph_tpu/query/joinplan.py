@@ -526,9 +526,91 @@ def try_mxu_route(engine, child, src: np.ndarray, resolver) -> bool:
             )
         return np.asarray(masks_dev), np.asarray(totals_dev)
 
+    # segmented dataflow (PR 18): k levels of the mask chain per
+    # dispatched program, the post-filter frontier mask threaded
+    # (device-resident) between segments, a scheduler yield point at
+    # every seam.  Per-level math is untouched: the stacked per-segment
+    # (masks, totals) concatenate to the monolithic result.
+    from dgraph_tpu.sched import segments
+
+    seg_k = segments.plan(
+        len(levels), max(1, est_total // max(1, len(levels))), "mask_chain"
+    )
+    tile_ops = tuple((pt.bi, pt.bj, pt.tiles) for pt in tiles)
+    degvs = tuple(pt.degs for pt in tiles)
+    keep_masks: List = []
+
+    def _dispatch_segment(x, lo, hi):
+        fail.point("device.spgemm")
+        if lo == 0:
+            # first segment stages the root + keep masks (device-
+            # resident across every later segment)
+            x = spgemm.uids_to_mask(
+                jnp.asarray(
+                    ops.pad_to(src32, ops.bucket(max(1, len(src32))))
+                ),
+                m,
+            )
+            for ks in keeps_np:
+                keep_masks.append(
+                    None
+                    if ks is None
+                    else spgemm.uids_to_mask(
+                        jnp.asarray(
+                            ops.pad_to(ks, ops.bucket(max(1, len(ks))))
+                        ),
+                        m,
+                    )
+                )
+        md, td = spgemm.run_mask_chain(
+            tile_ops[lo:hi], tuple(keep_masks[lo:hi]), degvs[lo:hi], x
+        )
+        nxt = md[-1] if hi < len(levels) else None
+        # the fetch stays inside the watchdog bracket, like _dispatch
+        return np.asarray(md), np.asarray(td), nxt
+
     with hs, obs.stage(engine.stats, "mxu_join_ms"):
         try:
-            masks, totals = devguard.get().run("device.spgemm", _dispatch)
+            if seg_k <= 0 or seg_k >= len(levels):
+                masks, totals = devguard.get().run(
+                    "device.spgemm", _dispatch
+                )
+            else:
+                mask_parts, tot_parts = [], []
+                x = None
+                lo = 0
+                while lo < len(levels):
+                    if lo:
+                        segments.seam("mask_chain")
+                    hi = min(lo + seg_k, len(levels))
+                    mseg, tseg, x = devguard.get().run(
+                        "device.spgemm",
+                        lambda x=x, lo=lo, hi=hi: _dispatch_segment(
+                            x, lo, hi
+                        ),
+                    )
+                    mask_parts.append(mseg)
+                    tot_parts.append(tseg)
+                    lo = hi
+                    if lo < len(levels) and not mseg[-1].any():
+                        # drained frontier mask: every remaining level
+                        # is zero masks / zero totals — synthesize them
+                        # and stop dispatching
+                        segments.early_exit("mask_chain")
+                        r = len(levels) - lo
+                        mask_parts.append(
+                            np.zeros((r,) + mseg.shape[1:], mseg.dtype)
+                        )
+                        tot_parts.append(np.zeros((r,), tseg.dtype))
+                        break
+                masks = np.concatenate(mask_parts)
+                totals = np.concatenate(tot_parts)
+                if sp is not None:
+                    hs.set_attr("route", "mxu")
+                    hs.set_attr("levels", len(levels))
+                    hs.set_attr("preds", [sg.attr for sg in levels])
+                    hs.set_attr("mask_lanes", int(m))
+                    hs.set_attr("segments", -(-len(levels) // seg_k))
         except devguard.DeviceFaultError:
             # hot failover: decline the tile tier — the pairwise gather
             # chain (host-routed while the domain is sick) takes over;

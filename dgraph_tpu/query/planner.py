@@ -492,6 +492,71 @@ def repair_route(
     return repair, dec
 
 
+def segment_route(
+    n_steps: int, est_step_units: int, driver: str
+) -> Tuple[int, Optional[dict]]:
+    """Segmented dataflow execution (PR 18): how many steps (hop levels /
+    scan iterations / mask-chain levels) should one dispatched program
+    segment cover?  Returns (k, decision); ``k == 0`` means monolithic —
+    the caller runs the untouched pre-segmentation program.
+
+    Mode discipline (planconfig DGRAPH_TPU_SEGMENT): '0' never segments
+    (byte-identical legacy programs), 'force' always segments at the
+    DGRAPH_TPU_SEGMENT_K knob, 'auto' prices it.  A pinned
+    DGRAPH_TPU_SEGMENT_K is an operator override in auto mode too — the
+    planner then only decides WHETHER to segment, never re-sizes k.
+
+    Pricing: segmentation buys bounded yield latency (cancellation,
+    preemption, ``first:`` early-exit all wait at most one segment) and
+    pays ``ceil(n/k) - 1`` extra dispatches.  The model caps that
+    overhead at 10% of the monolithic estimate: k is the smallest
+    segment whose per-segment work dwarfs one dispatch by 10×, clamped
+    to [1, n_steps].  When even k == n_steps-1 cannot amortize a second
+    dispatch (tiny programs), the route stays monolithic — tiny
+    programs already yield between themselves."""
+    mode = planconfig.segment_mode()
+    if mode == "0" or n_steps <= 1:
+        return 0, None
+    if mode == "force":
+        return max(1, min(planconfig.segment_k(), n_steps)), None
+    if not enabled():
+        return 0, None
+    r = rates()
+    step_us = max(float(est_step_units), 1.0) * r["device_edge_us"]
+    if planconfig.overridden("DGRAPH_TPU_SEGMENT_K"):
+        k = max(1, min(planconfig.segment_k(), n_steps))
+    else:
+        # smallest k whose segment work is >= 10 dispatches of overhead
+        k = int(-(-10.0 * r["dispatch_us"] // step_us))
+        k = max(1, min(k, n_steps))
+    n_segs = -(-n_steps // k)
+    seg_c = n_segs * r["dispatch_us"] + n_steps * step_us
+    mono_c = r["dispatch_us"] + n_steps * step_us
+    if k >= n_steps:
+        dec = {
+            "kind": "segment",
+            "route": "monolithic",
+            "units": int(n_steps),
+            "driver": driver,
+            "k": 0,
+            "est_chosen_us": round(mono_c, 1),
+            "est_other_us": round(seg_c, 1),
+            "reason": "program too small to amortize a second dispatch",
+        }
+        return 0, dec
+    dec = {
+        "kind": "segment",
+        "route": "segmented",
+        "units": int(n_steps),
+        "driver": driver,
+        "k": int(k),
+        "est_chosen_us": round(seg_c, 1),
+        "est_other_us": round(mono_c, 1),
+        "reason": "bounded yield latency within 10% dispatch overhead",
+    }
+    return k, dec
+
+
 def mxu_fanout_ok(engine, est_total: int, n_levels: int) -> bool:
     """The MXU tier's fan-out admission: is this chain big enough to
     leave the host at all?  Shares chain_route's model (and its override

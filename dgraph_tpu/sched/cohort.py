@@ -125,7 +125,7 @@ class SchedRequest:
     __slots__ = (
         "parsed", "debug", "deadline", "enqueued", "key",
         "_done", "result", "stats", "error", "span", "queue_span",
-        "tenant", "cancel", "ledger",
+        "tenant", "cancel", "ledger", "slot_held", "slot_released",
     )
 
     def __init__(self, parsed, debug: bool = False,
@@ -159,6 +159,13 @@ class SchedRequest:
         # worker executes it (None when DGRAPH_TPU_LEDGER=0 — then the
         # slot costs one None store and is never read)
         self.ledger = None
+        # per-request tenant max_inflight accounting (PR 18): slot_held
+        # is set when the cohort pop reserves this member's in-flight
+        # slot; slot_released makes the release idempotent so a deadline
+        # lapse detected at a segment seam can free the slot BEFORE the
+        # 504 surfaces without the flush finally double-releasing it
+        self.slot_held = False
+        self.slot_released = False
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and (

@@ -77,6 +77,7 @@ cache gate and byte budget, cache/result.py).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -95,8 +96,10 @@ from dgraph_tpu.sched.cohort import (
     SchedRequest,
     hop_signature,
 )
+from dgraph_tpu.utils import planconfig as _planconfig
 from dgraph_tpu.utils.env import env_float as _env_f
 from dgraph_tpu.utils.failpoints import fail
+from dgraph_tpu.sched import segments as _segments
 from dgraph_tpu.utils.metrics import (
     SCHED_COALESCED,
     SCHED_COHORT_OCCUPANCY,
@@ -104,6 +107,8 @@ from dgraph_tpu.utils.metrics import (
     SCHED_QUEUE_DEPTH,
     SCHED_QUEUE_WAIT,
     SCHED_SHED,
+    SEGMENT_PREEMPT_US,
+    SEGMENT_YIELDS,
     TENANT_SHED,
 )
 
@@ -179,6 +184,11 @@ class CohortScheduler:
         # service time, which under zipf traffic is where the duplicates
         # actually are.
         self._inflight: Dict[object, list] = {}
+        # segmented preemption (PR 18): per-thread donation depth — a
+        # worker draining a higher-priority cohort at a segment seam
+        # must not preempt AGAIN from inside the donated flush (the
+        # critical query's own seams would otherwise recurse)
+        self._donation = threading.local()
         # load-adaptive cohort admission (query/planner.py): cohort size
         # and flush deadline move with MEASURED queue-wait and occupancy
         # inside hard bounds ([base, 8×base] batch, [base/8, base]
@@ -400,6 +410,22 @@ class CohortScheduler:
             self._tenant_inflight.pop(tenant, None)
         self._cond.notify_all()
 
+    def _release_req_slot_locked(self, req: SchedRequest) -> None:
+        """Release ONE member's reserved in-flight slot, idempotently
+        (caller holds self._cond).  Per-request accounting (PR 18): a
+        deadline lapse or cancellation detected at a segment SEAM frees
+        the slot right there — before the 504/499 surfaces — instead of
+        in _flush's finally after the rest of the cohort drains; the
+        finally's sweep then skips the already-released members."""
+        if self.qos is None or not req.slot_held or req.slot_released:
+            return
+        req.slot_released = True
+        self._release_inflight(req.tenant, 1)
+
+    def _release_req_slot(self, req: SchedRequest) -> None:
+        with self._cond:
+            self._release_req_slot_locked(req)
+
     def _note_done(self, reqs) -> None:
         """Depth bookkeeping for requests leaving the scheduler (shed,
         completed, or dealt a twin's result)."""
@@ -451,6 +477,8 @@ class CohortScheduler:
                             self._tenant_inflight.get(cohort.tenant, 0)
                             + len(cohort.reqs)
                         )
+                        for r in cohort.reqs:
+                            r.slot_held = True
                     return cohort, reason
                 if not self._queues:
                     self._cond.wait()
@@ -526,7 +554,14 @@ class CohortScheduler:
 
     # -- execution ---------------------------------------------------------
 
-    def _flush(self, cohort: Cohort, reason: str) -> None:
+    def _flush(
+        self, cohort: Cohort, reason: str, have_engine_lock: bool = False
+    ) -> None:
+        """Execute one popped cohort.  ``have_engine_lock=True`` is the
+        segmented-preemption donation path (PR 18): the donor worker
+        already holds the engine read lock (it is mid-query at a segment
+        seam) and utils/rwlock.py is NOT reentrant, so the donated flush
+        must run under the donor's hold instead of re-acquiring."""
         SCHED_FLUSHES.add(reason)
         SCHED_COHORT_OCCUPANCY.observe(len(cohort.reqs))
         now = time.monotonic()
@@ -555,7 +590,10 @@ class CohortScheduler:
                 # in-flight slots were reserved for the WHOLE cohort at
                 # pop time (_next_cohort); release the shed members'
                 # share now — only the live ones actually execute
-                self._release_inflight(cohort.tenant, len(shed))
+                # (idempotent per-request: _shed_deadline already freed
+                # each slot before failing the member)
+                for r in shed:
+                    self._release_req_slot_locked(r)
         if not live:
             # a fully-shed cohort is the STRONGEST overload signal the
             # controller can get — its queue waits must reach the EWMA
@@ -611,7 +649,12 @@ class CohortScheduler:
             # lands INSIDE the try, so every member fails cleanly through
             # req.fail below instead of killing the worker loop
             fail.point("sched.flush")
-            with srv._engine_lock.read():  # ONE read acquisition per cohort
+            lock_cm = (
+                contextlib.nullcontext()
+                if have_engine_lock
+                else srv._engine_lock.read()
+            )
+            with lock_cm:  # ONE read acquisition per cohort
                 # tenant in-flight cap bounds EXECUTION concurrency, not
                 # just cohort pick: a batch-class tenant with
                 # max_inflight=1 runs its cohort's leaders in waves of 1
@@ -682,13 +725,19 @@ class CohortScheduler:
             done: List[SchedRequest] = list(live)
             for lead, followers in attached:
                 for req in followers:
-                    self._complete_follower(req, lead, merger)
+                    self._complete_follower(
+                        req, lead, merger, have_engine_lock
+                    )
                     done.append(req)
             with self._cond:
                 self._note_done(done)
                 SCHED_QUEUE_DEPTH.set(self._depth)
-                if self.qos is not None and live:
-                    self._release_inflight(cohort.tenant, len(live))
+                if self.qos is not None:
+                    # per-request sweep: members whose slot already
+                    # freed at a segment seam (deadline/cancel) are
+                    # no-ops here
+                    for r in live:
+                        self._release_req_slot_locked(r)
             if flush_span is not None:
                 flush_span.set_attr(
                     "merged_hops", merger.merged_dispatches
@@ -717,7 +766,9 @@ class CohortScheduler:
             mb, fs = self._adaptive.base_batch, self._adaptive.base_flush_s
         self.max_batch, self.flush_s = mb, fs
 
-    def _complete_follower(self, req, lead, merger) -> None:
+    def _complete_follower(
+        self, req, lead, merger, have_engine_lock: bool = False
+    ) -> None:
         """Deal a singleflight leader's outcome to an attached twin."""
         if req.result is not None or req.error is not None:
             return
@@ -727,8 +778,14 @@ class CohortScheduler:
             req.complete(lead.result, lead.stats)
         elif isinstance(lead.error, SchedDeadlineError) and not req.expired():
             # leader ran out of budget but this twin still has some: run
-            # it for real (rare — needs its own read hold)
-            with self._server._engine_lock.read():
+            # it for real (rare — needs its own read hold, unless the
+            # donation path's donor already holds one)
+            lock_cm = (
+                contextlib.nullcontext()
+                if have_engine_lock
+                else self._server._engine_lock.read()
+            )
+            with lock_cm:
                 self._run_one(req, merger)
         else:
             req.fail(lead.error)
@@ -737,6 +794,10 @@ class CohortScheduler:
         SCHED_SHED.add("deadline")
         if self.qos is not None:
             TENANT_SHED.add((_qos.metric_label(req.tenant), "deadline"))
+        # free the tenant's in-flight slot BEFORE the 504 surfaces: the
+        # wave-cap wait must not outlive a dead query (idempotent — a
+        # member shed before its cohort popped never held a slot)
+        self._release_req_slot(req)
         req.fail(SchedDeadlineError(
             "deadline expired while queued "
             f"({(now - req.enqueued) * 1e3:.1f}ms in cohort)"
@@ -759,6 +820,7 @@ class CohortScheduler:
             if req.cancel is not None and req.cancel.cancelled:
                 # cancelled between admission and execution (client
                 # disconnect / admin): never touch the engine
+                self._release_req_slot(req)
                 req.fail(req.cancel.error())
                 return
             req.end_queue_wait("run")
@@ -783,9 +845,30 @@ class CohortScheduler:
                 eng.cancel = req.cancel
                 eng.dump_shapes = bool(srv.dumpsg_path)
                 token = outputnode.DEBUG_UIDS.set(req.debug)
+                # segmented dataflow (PR 18): every segment seam inside
+                # the fused drivers probes this context — the request's
+                # cancel token (mid-program cancellation), the
+                # preemption-donation hook (a higher-priority arrival
+                # drains at the next seam on THIS thread), and the
+                # stats dict planner segment decisions record into
+                # DGRAPH_TPU_SEGMENT=0 restores the pre-segmentation
+                # scheduler whole: no seams AND no donation, so the A/B
+                # (bench_slo seg arm) measures segmentation, not a
+                # half-armed preemption hook riding per-hop checkpoints
+                seg_prev = _segments.activate(_segments.SegmentContext(
+                    token=req.cancel,
+                    preempt=(
+                        (lambda: self._maybe_preempt(req))
+                        if self.qos is not None
+                        and _planconfig.segment_mode() != "0"
+                        else None
+                    ),
+                    stats=eng.stats,
+                ))
                 try:
                     out = eng.run_parsed(req.parsed)
                 finally:
+                    _segments.deactivate(seg_prev)
                     outputnode.DEBUG_UIDS.reset(token)
                 es.set_attr("edges", eng.stats.get("edges", 0))
             if srv.dumpsg_path and eng.last_dump:
@@ -797,11 +880,75 @@ class CohortScheduler:
                 req.ledger.merge_engine_stats(eng.stats)
             req.complete(out, dict(eng.stats))
         except BaseException as e:  # noqa: BLE001 — delivered via req.fail
+            if isinstance(e, (_qos.QueryCancelledError, SchedDeadlineError)):
+                # died at a checkpoint/seam: free the tenant's in-flight
+                # slot before the 499/504 surfaces — under segmentation
+                # the wave-cap wait must not outlive this query's
+                # remaining segments
+                self._release_req_slot(req)
             req.fail(e)
         finally:
             if ltoken is not None:
                 _ledgermod.deactivate(ltoken)
             merger.leave()
+
+    # -- segmented preemption (PR 18) ---------------------------------------
+
+    def _maybe_preempt(self, req: SchedRequest) -> None:
+        """Segment-seam preemption: called by the running query's
+        ``segments.seam()`` between program segments.  If a cohort from
+        a STRICTLY higher priority class is queued and admissible, pop
+        it and drain it inline on this thread — the preempted query's
+        carry parks on this stack and resumes when the donated flush
+        returns.  This turns DRR priority from admission-ordering into
+        real preemption: a critical arrival runs at the standard query's
+        next seam instead of behind its remaining segments.
+
+        The donor already holds the engine read lock, so the donated
+        flush runs with ``have_engine_lock=True`` (utils/rwlock.py is
+        not reentrant).  A per-thread depth guard keeps the donated
+        query's own seams from preempting recursively."""
+        if self.qos is None or self._stopped:
+            return
+        if getattr(self._donation, "depth", 0) > 0:
+            return
+        my = _qos.PRIORITY_FACTORS.get(
+            self.qos.tenant(req.tenant).priority, 1.0
+        )
+        with self._cond:
+            best_key, best_f = None, 0.0
+            for key, c in self._queues.items():
+                f = _qos.PRIORITY_FACTORS.get(
+                    self.qos.tenant(c.tenant).priority, 1.0
+                )
+                if f <= my or not self._tenant_admissible(c.tenant):
+                    continue
+                if (
+                    best_key is None
+                    or f > best_f
+                    or (f == best_f
+                        and c.born < self._queues[best_key].born)
+                ):
+                    best_key, best_f = key, f
+            if best_key is None:
+                return
+            cohort = self._queues.pop(best_key)
+            # reserve the in-flight slots in the same hold as the
+            # admissibility check, exactly like _next_cohort
+            self._tenant_inflight[cohort.tenant] = (
+                self._tenant_inflight.get(cohort.tenant, 0)
+                + len(cohort.reqs)
+            )
+            for r in cohort.reqs:
+                r.slot_held = True
+            waited = time.monotonic() - cohort.born
+        SEGMENT_PREEMPT_US.observe(waited * 1e6)
+        SEGMENT_YIELDS.add("preempt")
+        self._donation.depth = getattr(self._donation, "depth", 0) + 1
+        try:
+            self._flush(cohort, "preempt", have_engine_lock=True)
+        finally:
+            self._donation.depth -= 1
 
     # -- introspection -----------------------------------------------------
 

@@ -1,0 +1,135 @@
+"""Segmented dataflow execution (PR 18): the scheduler yield point.
+
+The fused drivers (ops/batch.py multi_hop, query/chain.py _run_fused,
+the MXU mask chain in query/joinplan.py, mesh/executor.py multi_hop)
+historically ran each query as ONE dispatched XLA program, so a
+mega-query held its execution slot to completion: cancellation latency
+was a whole program, QoS priority classes could only reorder ADMISSION
+(DRR weights never preempt a running dispatch), and victim p999 under a
+deep-chain antagonist was gated by the antagonist's longest dispatch.
+Banyan (PAPERS.md) argues a graph service needs scheduling scopes
+*inside* a query, not just around it.
+
+This module is the seam between those drivers and the scheduler.  Each
+driver now emits bounded k-step segments (planner.segment_route prices
+k; DGRAPH_TPU_SEGMENT gates it) with a ``seam()`` call between
+dispatches.  One seam does three things, in order:
+
+1. **failpoint** — ``fail.point("segment.seam")`` so tests and the
+   bench can inject per-segment delay and measure the yield latency
+   bound directly;
+2. **cancellation** — probe the request's ``CancelToken``: a deadline
+   lapse, client disconnect, or /admin/cancel now surfaces within ONE
+   segment instead of one whole program (the PR 11 checkpoint
+   discipline pushed inside the fused drivers);
+3. **preemption** — invoke the scheduler's donation hook: when a
+   strictly higher-priority cohort is queued, the running worker drains
+   it INLINE at this segment boundary (the preempted query's carry
+   parks on the worker's stack and resumes after the critical cohort
+   completes), turning DRR from admission-ordering into real
+   preemption.  ``dgraph_segment_preempt_us`` records how long the
+   critical arrival waited for a seam.
+
+The context is thread-local and activated by the scheduler around
+``engine.run_parsed`` (token + preempt hook + stats), or by the engine
+itself token-only when no scheduler is driving (embedded engines still
+get seam cancellation).  ``seam()`` with no active context is a cheap
+no-op — the drivers never need to know who is running them.
+
+``plan()`` wraps ``planner.segment_route`` so drivers get one call that
+prices k, records the decision into the active request's stats, and
+counts the dispatch metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from dgraph_tpu.utils.failpoints import fail
+from dgraph_tpu.utils.metrics import SEGMENT_DISPATCHES, SEGMENT_YIELDS
+
+_tls = threading.local()
+
+
+class SegmentContext:
+    """Per-request yield-point wiring: the cancel token to probe at each
+    seam, the scheduler's preemption-donation hook, and the stats dict
+    planner decisions record into."""
+
+    __slots__ = ("token", "preempt", "stats")
+
+    def __init__(
+        self,
+        token=None,
+        preempt: Optional[Callable[[], None]] = None,
+        stats: Optional[dict] = None,
+    ):
+        self.token = token
+        self.preempt = preempt
+        self.stats = stats
+
+
+def activate(ctx: Optional[SegmentContext]) -> Optional[SegmentContext]:
+    """Install ``ctx`` as this thread's active context; returns the
+    PREVIOUS one so callers restore it in a finally — preemption
+    donation runs a whole other query inline on the donor's thread, and
+    the donor's context must survive it."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    return prev
+
+
+def deactivate(prev: Optional[SegmentContext] = None) -> None:
+    _tls.ctx = prev
+
+
+def current() -> Optional[SegmentContext]:
+    return getattr(_tls, "ctx", None)
+
+
+def seam(driver: str) -> None:
+    """One scheduler yield point, called by a segment driver BETWEEN
+    dispatches (never before the first or after the last — a monolithic
+    program and a 1-segment program run zero seams, byte-identically).
+
+    Order matters: cancellation first (a dead query must not donate its
+    slot to drain someone else's cohort), preemption second.  A token
+    raise propagates — the driver's partial carry is donated device
+    memory and simply dropped with the query."""
+    fail.point("segment.seam")
+    ctx = current()
+    if ctx is None:
+        return
+    tok = ctx.token
+    if tok is not None:
+        try:
+            tok.check()
+        except BaseException:
+            SEGMENT_YIELDS.add("cancel")
+            raise
+    if ctx.preempt is not None:
+        ctx.preempt()
+
+
+def early_exit(driver: str) -> None:
+    """Record a carry-accumulation early exit (child-level ``first:``
+    pagination satisfied / frontier drained mid-chain): the remaining
+    segments are never dispatched."""
+    SEGMENT_YIELDS.add("early_exit")
+
+
+def plan(n_steps: int, est_step_units: int, driver: str) -> int:
+    """Price the segment size for one driver invocation.  Returns k
+    (0 = run the untouched monolithic program).  Records the planner
+    decision into the active request's stats — the ``chain_reject``
+    explainability discipline — and counts the segmented dispatches."""
+    from dgraph_tpu.query import planner
+
+    k, dec = planner.segment_route(n_steps, est_step_units, driver)
+    if dec is not None:
+        ctx = current()
+        planner.record(ctx.stats if ctx is not None else None, dec)
+    if k > 0:
+        SEGMENT_DISPATCHES.add(driver)
+    return k

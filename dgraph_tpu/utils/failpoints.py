@@ -51,7 +51,10 @@ Instrumented sites (grep ``fail.point``): every PeerClient attempt
 raft.send), snapshot decode (``service.snapshot_decode``), the cohort
 scheduler's flush (``sched.flush``), the engine's per-level hop
 dispatch (``engine.hop`` — the cancellation-checkpoint seam; arm
-``delay(ms=...)`` to stretch it for mid-flight cancel tests), and the
+``delay(ms=...)`` to stretch it for mid-flight cancel tests), the
+segment seam between bounded program segments
+(``segment.seam`` — sched/segments.py; arm ``delay(ms=...)`` to widen
+the yield window for preemption/cancellation-latency tests), and the
 storage plane's
 durability-critical sites (``wal.append``, ``wal.flush``,
 ``wal.post_flush``, ``wal.seal``, ``wal.snapshot.{tmp,replace,

@@ -543,6 +543,28 @@ TENANT_SHED = metrics.multilabeled(
     "dgraph_tenant_shed_total", ("tenant", "reason")
 )
 
+# segmented dataflow execution (sched/segments.py, PR 18): the fused
+# drivers emit bounded k-step program segments with a scheduler yield
+# point at every seam.  SEGMENT_DISPATCHES counts segmented driver
+# invocations per driver; SEGMENT_YIELDS counts seams that actually
+# yielded (cancel / early_exit — preemptions are counted by the
+# histogram below); SEGMENT_PREEMPT_US is how long a higher-priority
+# cohort waited for the running query's next segment boundary — the
+# PREEMPTION LATENCY, bounded by one segment's dispatch.  Alert when
+# its p99 approaches a whole monolithic program: segmentation has
+# stopped engaging (planner mispricing or DGRAPH_TPU_SEGMENT=0 left
+# pinned after an incident).
+SEGMENT_DISPATCHES = metrics.labeled(
+    "dgraph_segment_dispatches_total", label="driver"
+)
+SEGMENT_YIELDS = metrics.labeled(
+    "dgraph_segment_yields_total", label="reason"
+)
+SEGMENT_PREEMPT_US = metrics.histogram(
+    "dgraph_segment_preempt_us",
+    (100.0, 500.0, 1000.0, 5000.0, 25000.0, 100000.0, 500000.0, 2000000.0),
+)
+
 # two-tier query cache surface (dgraph_tpu/cache/): per-tier event
 # counters (hit / miss / stale / evicted / rejected), occupancy-bytes
 # gauges, and the shared hit-age histogram — hit age tells an operator

@@ -80,6 +80,19 @@ DGRAPH_TPU_IVM_REPAIR_MAX_DELTA 512  hard cap on the edge-delta size the
                                      larger mutation batches drop the
                                      affected views (static fallback
                                      gate when the planner is off)
+DGRAPH_TPU_SEGMENT          "auto"   segmented dataflow execution (PR 18):
+                                     the fused drivers emit bounded
+                                     k-step program segments with a
+                                     scheduler yield point at every seam.
+                                     "0" monolithic always (byte-identical
+                                     pre-segmentation programs) / "auto"
+                                     planner-priced segment size /
+                                     "force" always segment at the k knob
+DGRAPH_TPU_SEGMENT_K           4     steps (hop levels / scan iterations /
+                                     mask-chain levels) per dispatched
+                                     segment when segmentation engages;
+                                     pinning it is an operator override —
+                                     the planner then never re-sizes k
 ========================== ========= =====================================
 
 Reads happen per call (not at import) so tests can flip knobs with
@@ -110,6 +123,7 @@ TILE_BUDGET_DEFAULT = 1 << 28
 CLASS_W_MAX_DEFAULT = 10
 CALIBRATION_FILE_DEFAULT = "scratch/planner_calib.json"
 IVM_REPAIR_MAX_DELTA_DEFAULT = 512
+SEGMENT_K_DEFAULT = 4
 
 
 def overridden(name: str) -> bool:
@@ -245,6 +259,21 @@ def ivm_repair_max_delta() -> int:
     return _int(
         "DGRAPH_TPU_IVM_REPAIR_MAX_DELTA", IVM_REPAIR_MAX_DELTA_DEFAULT
     )
+
+
+def segment_mode() -> str:
+    """DGRAPH_TPU_SEGMENT: '0' monolithic always (byte-identical
+    pre-segmentation programs), 'auto' (default; planner.segment_route
+    prices the segment size from calibrated dispatch overhead), 'force'
+    always segment at the DGRAPH_TPU_SEGMENT_K knob."""
+    return os.environ.get("DGRAPH_TPU_SEGMENT", "auto")
+
+
+def segment_k() -> int:
+    """Steps per dispatched program segment when segmentation engages.
+    Pinning it (env) is an operator override — auto mode then only
+    decides WHETHER to segment, never re-sizes k."""
+    return _int("DGRAPH_TPU_SEGMENT_K", SEGMENT_K_DEFAULT)
 
 
 def calibrate_at_boot() -> bool:

@@ -642,6 +642,89 @@ def test_unchecked_hop_loop_counterexamples_clean():
     ) == []
 
 
+def test_unchecked_segment_loop_flagged_in_all_driver_layers():
+    """PR 18: a loop re-dispatching a program segment without a seam
+    probe is flagged — including in ops/ and mesh/, where the plain
+    hop-loop rule is exempt (segment loops are HOST loops between
+    bounded programs, exactly where a yield point is possible)."""
+    bad = textwrap.dedent("""
+        def run_segments(carry, n, k):
+            lo = 0
+            while lo < n:
+                carry = _dispatch_segment(carry, lo, min(lo + k, n))
+                lo += k
+            return carry
+    """)
+    for path in (
+        "dgraph_tpu/ops/batch.py",
+        "dgraph_tpu/query/chain.py",
+        "dgraph_tpu/mesh/executor.py",
+    ):
+        assert _ids(
+            check_source(bad, [UncheckedHopLoop()], path=path)
+        ) == ["unchecked-hop-loop"], path
+    # the method-call shape is the same seam
+    bad2 = textwrap.dedent("""
+        def run(self, parts):
+            for lo, hi in parts:
+                self._dispatch_segment(lo, hi)
+    """)
+    assert _ids(
+        check_source(bad2, [UncheckedHopLoop()], path="dgraph_tpu/ops/x.py")
+    ) == ["unchecked-hop-loop"]
+
+
+def test_unchecked_segment_loop_counterexamples_clean():
+    # the fix: a segments.seam() yield point between dispatches
+    seamed = textwrap.dedent("""
+        from dgraph_tpu.sched import segments
+
+        def run_segments(carry, n, k):
+            lo = 0
+            while lo < n:
+                if lo:
+                    segments.seam("chain")
+                carry = _dispatch_segment(carry, lo, min(lo + k, n))
+                lo += k
+            return carry
+    """)
+    assert check_source(
+        seamed, [UncheckedHopLoop()], path="dgraph_tpu/ops/batch.py"
+    ) == []
+    # a direct token probe between dispatches also satisfies the rule
+    tokened = textwrap.dedent("""
+        def run_segments(self, parts):
+            for lo, hi in parts:
+                self.cancel_token.check()
+                self._dispatch_segment(lo, hi)
+    """)
+    assert check_source(
+        tokened, [UncheckedHopLoop()], path="dgraph_tpu/mesh/executor.py"
+    ) == []
+    # ordinary ops/ dispatch loops stay exempt: only the segment-carry
+    # convention opts a loop in outside query/
+    plain = textwrap.dedent("""
+        def kernel(ce, fronts):
+            for f in fronts:
+                ce.expand(f)
+    """)
+    assert check_source(
+        plain, [UncheckedHopLoop()], path="dgraph_tpu/ops/kern.py"
+    ) == []
+    # pragma escape hatch with the WHY
+    pragmad = textwrap.dedent("""
+        def replay_segments(carry, parts):
+            # offline fixture replay: no live client, nothing queued
+            # graftlint: ignore[unchecked-hop-loop]
+            for lo, hi in parts:
+                carry = _dispatch_segment(carry, lo, hi)
+            return carry
+    """)
+    assert check_source(
+        pragmad, [UncheckedHopLoop()], path="dgraph_tpu/query/fixture.py"
+    ) == []
+
+
 def test_unregistered_metric_flagged():
     """Golden-bad: a dgraph_* series with no docs/deploy.md catalog row
     must be flagged — and the catalog is pinned for the test so the
