@@ -77,6 +77,22 @@ def main(argv=None) -> int:
         help="alternate goldens file for --programs (default: "
              "analysis/programs.json)",
     )
+    ap.add_argument(
+        "--races", action="store_true",
+        help="run the static thread-escape pass (tier 3) instead of the "
+             "lint/lock passes; exits nonzero on any finding not in the "
+             "sanctioned-shared manifest",
+    )
+    ap.add_argument(
+        "--shared-manifest", metavar="PATH", default=None,
+        help="sanctioned-shared manifest for --races (default: "
+             "analysis/shared.json)",
+    )
+    ap.add_argument(
+        "--write-shared", metavar="PATH", default=None,
+        help="write current escape findings as the sanctioned-shared "
+             "manifest and exit 0 (adoption aid, not a silencer)",
+    )
     ns = ap.parse_args(argv)
 
     if ns.programs or ns.update_programs:
@@ -103,6 +119,39 @@ def main(argv=None) -> int:
     pkg_root = Path(__file__).resolve().parents[1]   # dgraph_tpu/
     repo_root = pkg_root.parent
     roots = ns.paths or [str(pkg_root)]
+
+    if ns.races or ns.write_shared:
+        # tier 3 (static half) runs alone, like --programs: same AST
+        # substrate as lint/locks but a different verdict and manifest
+        from dgraph_tpu.analysis.escape import check_escapes
+
+        findings = check_escapes(
+            roots, repo_root=str(repo_root), exclude=_DEFAULT_EXCLUDE
+        )
+        if ns.write_shared:
+            write_baseline(ns.write_shared, findings)
+            print(
+                f"wrote {len(findings)} fingerprint(s) to {ns.write_shared}"
+            )
+            return 0
+        manifest = ns.shared_manifest or str(
+            Path(__file__).resolve().parent / "shared.json"
+        )
+        fresh = apply_baseline(findings, load_baseline(manifest))
+        for f in fresh:
+            print(f.render())
+        n_base = len(findings) - len(fresh)
+        if fresh:
+            print(
+                f"\nthread-escape: {len(fresh)} finding(s)"
+                + (f" ({n_base} sanctioned)" if n_base else "")
+            )
+            return 1
+        print(
+            "thread-escape: clean"
+            + (f" ({n_base} sanctioned)" if n_base else "")
+        )
+        return 0
 
     rc = 0
     if not ns.no_lint:

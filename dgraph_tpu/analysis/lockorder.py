@@ -32,6 +32,7 @@ Condition is reported as a finding (it would self-deadlock).
 from __future__ import annotations
 
 import ast
+import re
 from collections import defaultdict
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -400,6 +401,219 @@ def _collect_edges(fi, g, by_attr, exact) -> None:
         for held, callee, site in fa.calls_under:
             for lk in trans.get(callee, ()):  # callee's (transitive) locks
                 g.add_edge(held, lk, site, via=callee)
+
+
+# -- thread-entry discovery -------------------------------------------------
+#
+# One entry model shared by the static passes: the lock-order graph and
+# the thread-escape pass (analysis/escape.py) must agree about *which*
+# functions run on their own thread, or the two reports contradict each
+# other.  An entry is any function handed to the threading runtime:
+#
+#   threading.Thread(target=self.x) / Thread(target=fn)   kind="thread"
+#   <tracked executor>.submit(fn, ...)                    kind="executor"
+#   threading.Timer(t, fn)                                kind="timer"
+#   do_GET/do_POST on a *RequestHandler class             kind="conn-handler"
+#   public methods on a *Servicer class                   kind="handler"
+#   # graftlint: thread-entry   (on/above the def line)   kind="pragma"
+#
+# ``multi`` means the entry can be live on MORE than one thread at once:
+# spawned inside a loop/comprehension, submitted to a pool, or invoked
+# per-connection by a server.  Escape analysis counts a multi entry as
+# two contexts on its own.
+#
+# Executor receivers are *tracked*: only ``.submit`` on a local or
+# self-attribute that was assigned a ``*PoolExecutor(...)`` counts —
+# ``self.hop_merger.submit(...)`` (a plain object with a submit method)
+# is not a thread entry and must not be classified as one.
+
+_ENTRY_PRAGMA_RE = re.compile(r"#\s*graftlint:\s*thread-entry\b")
+_EXECUTOR_CTOR_RE = re.compile(r"(^|\.)(Thread|Process)PoolExecutor$")
+
+
+@dataclass(frozen=True)
+class ThreadEntry:
+    qual: str    # module.Class.meth or module.fn
+    site: str    # path:line of the spawn/registration/def site
+    kind: str    # thread | executor | timer | handler | pragma
+    multi: bool  # can run on >1 thread concurrently
+
+
+def _is_executor_ctor(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and bool(
+        _EXECUTOR_CTOR_RE.search(_dotted(node.func))
+    )
+
+
+def _callable_quals(
+    expr: ast.AST, module: str, cls: Optional[str]
+) -> List[str]:
+    """Resolve a callable expression to qualified name(s).
+
+    ``self.x`` → ``module.Class.x``; a bare name → ``module.name``;
+    a lambda resolves to every ``self.meth(...)`` call in its body
+    (``target=lambda: self._loop(arg)``).  Unresolvable receivers
+    (``srv.serve_forever``) yield nothing — dropped, not guessed.
+    """
+    if isinstance(expr, ast.Lambda):
+        out: List[str] = []
+        for sub in ast.walk(expr.body):
+            if isinstance(sub, ast.Call):
+                q = _call_target_qual(sub.func, module, cls)
+                if q:
+                    out.append(q)
+        return out
+    q = _call_target_qual(expr, module, cls)
+    return [q] if q else []
+
+
+def _call_target_qual(
+    f: ast.AST, module: str, cls: Optional[str]
+) -> Optional[str]:
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "self"
+        and cls
+    ):
+        return f"{module}.{cls}.{f.attr}"
+    if isinstance(f, ast.Name):
+        return f"{module}.{f.id}"
+    return None
+
+
+def discover_thread_entries(
+    tree: ast.AST,
+    module: str,
+    path: str,
+    source_lines: Optional[Sequence[str]] = None,
+) -> List[ThreadEntry]:
+    """All thread entry points declared in one parsed module."""
+    entries: List[ThreadEntry] = []
+    lines = source_lines or []
+
+    def has_entry_pragma(lineno: int) -> bool:
+        for ln in (lineno, lineno - 1):
+            if 1 <= ln <= len(lines) and _ENTRY_PRAGMA_RE.search(lines[ln - 1]):
+                return True
+        return False
+
+    def scan_callable(fn: ast.AST, cls: Optional[str], exec_attrs: Set[str]):
+        """Find spawn sites anywhere in ``fn`` (closures included — a
+        Thread started from a nested def still starts)."""
+        # locals assigned an executor ctor, incl. `with ...Executor() as ex:`
+        exec_locals: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _is_executor_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        exec_locals.add(t.id)
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    if _is_executor_ctor(item.context_expr) and isinstance(
+                        item.optional_vars, ast.Name
+                    ):
+                        exec_locals.add(item.optional_vars.id)
+
+        loopy: Set[int] = set()  # id() of Call nodes under a lexical loop
+
+        def mark_loops(node: ast.AST, in_loop: bool) -> None:
+            if isinstance(node, ast.Call) and in_loop:
+                loopy.add(id(node))
+            nxt = in_loop or isinstance(
+                node,
+                (ast.For, ast.AsyncFor, ast.While,
+                 ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp),
+            )
+            for ch in ast.iter_child_nodes(node):
+                mark_loops(ch, nxt)
+
+        mark_loops(fn, False)
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dq = _dotted(node.func)
+            site = f"{path}:{node.lineno}"
+            if dq in ("threading.Thread", "Thread"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        for q in _callable_quals(kw.value, module, cls):
+                            entries.append(ThreadEntry(
+                                q, site, "thread", id(node) in loopy
+                            ))
+            elif dq in ("threading.Timer", "Timer") and len(node.args) >= 2:
+                for q in _callable_quals(node.args[1], module, cls):
+                    entries.append(ThreadEntry(q, site, "timer", False))
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "submit"
+                and node.args
+            ):
+                recv = node.func.value
+                tracked = (
+                    isinstance(recv, ast.Name) and recv.id in exec_locals
+                ) or (
+                    isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"
+                    and recv.attr in exec_attrs
+                )
+                if tracked:
+                    for q in _callable_quals(node.args[0], module, cls):
+                        entries.append(ThreadEntry(q, site, "executor", True))
+
+    def class_executor_attrs(cd: ast.ClassDef) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(cd):
+            if isinstance(node, ast.Assign) and _is_executor_ctor(node.value):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        out.add(t.attr)
+        return out
+
+    body = tree.body if isinstance(tree, ast.Module) else []
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_callable(node, None, set())
+            if has_entry_pragma(node.lineno):
+                entries.append(ThreadEntry(
+                    f"{module}.{node.name}", f"{path}:{node.lineno}",
+                    "pragma", True,
+                ))
+        elif isinstance(node, ast.ClassDef):
+            exec_attrs = class_executor_attrs(node)
+            base_names = [_dotted(b) for b in node.bases]
+            is_http_handler = any("RequestHandler" in b for b in base_names)
+            is_servicer = node.name.endswith("Servicer") or any(
+                b.endswith("Servicer") for b in base_names
+            )
+            for sub in node.body:
+                if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                qual = f"{module}.{node.name}.{sub.name}"
+                scan_callable(sub, node.name, exec_attrs)
+                if has_entry_pragma(sub.lineno):
+                    entries.append(ThreadEntry(
+                        qual, f"{path}:{sub.lineno}", "pragma", True
+                    ))
+                elif is_http_handler and re.fullmatch(r"do_[A-Z]+", sub.name):
+                    # one handler INSTANCE per connection: the methods
+                    # run on many threads, but each instance is
+                    # single-threaded — escape analysis must not treat
+                    # instance attrs of a conn-handler as shared
+                    entries.append(ThreadEntry(
+                        qual, f"{path}:{sub.lineno}", "conn-handler", True
+                    ))
+                elif is_servicer and not sub.name.startswith("_"):
+                    entries.append(ThreadEntry(
+                        qual, f"{path}:{sub.lineno}", "handler", True
+                    ))
+    return entries
 
 
 # -- entry ------------------------------------------------------------------

@@ -37,20 +37,87 @@ Exclusions (documented, deliberate):
 Armed for the whole tier-1 run by ``tests/conftest.py``; any inversion
 fails the session.  ``Witness()`` instances can also be used directly
 (the seeded-inversion test in tests/test_analysis.py does).
+
+**Tier 3 — Eraser lockset witness** (co-gated by ``DGRAPH_TPU_RACES``,
+default on whenever the lock witness is armed): classes that declare
+``__race_fields__ = frozenset({...})`` get their ``__setattr__``
+wrapped *at arm time* — the unarmed serving path keeps the original
+slot/dict setattr and allocates nothing.  Every write to a declared
+field feeds the classic lockset state machine (Savage et al.):
+
+- first write → *Exclusive*, owned by the writing thread; same-owner
+  writes are a lock-free fast path and — authentic Eraser — do NOT
+  refine the lockset, so init-before-share patterns stay silent;
+- first write by a second thread → *Shared-Modified*; the candidate
+  lockset becomes the locks that thread holds (witnessed wrappers on
+  the per-thread held stack).  An empty lockset here is the tolerated
+  single-writer HAND-OFF (scheduler → flush worker), not yet a race;
+- every further write intersects the lockset with the held set; an
+  EMPTY lockset on a write by a thread other than the last writer is
+  a data race — reported with both write sites and failing the session
+  through the same ``sessionfinish`` path as lock inversions.
+
+Explicit hand-off points reset a struct's field states (new epoch, new
+owner): ``obs.ledger.activate`` and ``SchedRequest.complete/fail`` are
+wrapped at arm time, mirroring the happens-before edges the pooled
+ledger actually relies on (``req.wait()``/``complete()``).
+
+Scope note: ``__setattr__`` sees attribute REBINDS — scalar counters,
+state enums, published references.  ``self.d[k] = v`` mutates the dict,
+not the attribute; container-valued fields are covered by locking the
+container writes (the static escape pass checks those sites).
 """
 
 from __future__ import annotations
 
 import itertools
+import os
 import sys
 import threading as _real_threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, FrozenSet, List, Optional, Tuple
 
 _INFRA_FILES = ("analysis/witness.py", "utils/rwlock.py", "threading.py")
 
 # per-wrapper monotonic serials (NOT id(): ids recycle after GC and a
 # recycled id could alias a dead lock into a false inversion)
 _serial = itertools.count(1)
+
+# writer identity for the lockset state machine (NOT get_ident(): the
+# OS recycles idents the moment a thread exits, so two short-lived
+# sequential writers would alias into one and hide the alternation that
+# defines a ping-pong race)
+_thread_tokens = itertools.count(1)
+_tls = _real_threading.local()
+
+
+def _thread_token() -> int:
+    tok = getattr(_tls, "token", None)
+    if tok is None:
+        tok = next(_thread_tokens)
+        _tls.token = tok
+    return tok
+
+
+def races_enabled() -> bool:
+    """Lockset-witness gate: ``DGRAPH_TPU_RACES=0`` opts out (the lock
+    witness itself stays governed by ``DGRAPH_TPU_WITNESS``)."""
+    return os.environ.get("DGRAPH_TPU_RACES", "1") != "0"
+
+
+def _short_stack(skip: int = 2, depth: int = 4) -> str:
+    """Compact caller stack (innermost first), infra frames elided."""
+    try:
+        f = sys._getframe(skip)
+    except ValueError:  # pragma: no cover
+        return "<unknown>"
+    parts: List[str] = []
+    while f is not None and len(parts) < depth:
+        fn = f.f_code.co_filename.replace("\\", "/")
+        if not any(fn.endswith(s) for s in _INFRA_FILES):
+            short = "/".join(fn.rsplit("/", 3)[-3:])
+            parts.append(f"{short}:{f.f_lineno}")
+        f = f.f_back
+    return " <- ".join(parts) or "<unknown>"
 
 
 def _creation_site(skip: int = 2) -> str:
@@ -89,9 +156,15 @@ class Witness:
         self._inst_order: Dict[Tuple[int, int], str] = {}
         self._inst_saturated = False
         self._inversions: List[str] = []
+        # Eraser lockset state: instance serial -> field -> _FieldState
+        self._fields: Dict[int, Dict[str, "_FieldState"]] = {}
+        self._field_count = 0
+        self._field_saturated = False
+        self._races: List[str] = []
         self.active = True
 
     _INST_CAP = 100_000  # instance-pair table bound (serials churn)
+    _FIELD_CAP = 200_000  # field-state table bound (instances churn)
 
     # -- core events --------------------------------------------------------
 
@@ -167,15 +240,141 @@ class Witness:
                 del held[i]
                 return
 
+    # -- Eraser lockset (tier 3) --------------------------------------------
+
+    def note_field_write(self, obj, name: str) -> None:
+        """One write to a declared race field — drive the lockset state
+        machine.  The same-owner Exclusive path is lock-free and walks
+        no frames: that is the overhead bound for single-writer structs
+        (ledgers between hand-offs, per-request state)."""
+        if not self.active:
+            return
+        try:
+            s = getattr(obj, "_race_serial", None)
+        except Exception:  # noqa: BLE001 — exotic __getattr__: not ours
+            return
+        if s is None:
+            try:
+                s = next(_serial)
+                object.__setattr__(obj, "_race_serial", s)
+            except (AttributeError, TypeError):
+                return  # __slots__ without a _race_serial slot
+        tid = _thread_token()
+        per = self._fields.get(s)
+        st = per.get(name) if per is not None else None
+        if st is None:
+            with self._mu:
+                per = self._fields.setdefault(s, {})
+                st = per.get(name)
+                if st is None:
+                    if self._field_count >= self._FIELD_CAP:
+                        if not self._field_saturated:
+                            # no silent caps: say so once, loudly
+                            self._field_saturated = True
+                            print(
+                                "graftcheck witness: field-state table "
+                                f"hit its {self._FIELD_CAP}-entry cap; "
+                                "race detection is degraded for the "
+                                "rest of this run",
+                                file=sys.stderr,
+                            )
+                        return
+                    per[name] = _FieldState(tid, _short_stack(3))
+                    self._field_count += 1
+                    _bump_fields_metric()
+                    return
+        if not st.shared and st.owner == tid:
+            return  # Exclusive, same owner: Eraser does NOT refine here
+        heldset = frozenset(self._held())
+        with self._mu:
+            if not st.shared:
+                # Exclusive -> Shared-Modified: the candidate lockset is
+                # whatever the second writer holds.  Empty is the
+                # tolerated single hand-off, not yet a race.
+                st.shared = True
+                st.lockset = heldset
+                st.last_writer = tid
+                st.last_site = _short_stack(3)
+                return
+            ls = st.lockset & heldset
+            alternated = tid != st.last_writer
+            prev_writer, prev_site = st.last_writer, st.last_site
+            st.lockset = ls
+            st.last_writer = tid
+            if ls:
+                # locked steady state: elide the stack walk (hot path
+                # for properly-guarded shared counters)
+                return
+            site = _short_stack(3)
+            st.last_site = site
+            if alternated and not st.reported:
+                st.reported = True
+                self._races.append(
+                    f"data race: {type(obj).__name__}.{name} "
+                    f"(instance #{s}): write by thread {tid} at [{site}] "
+                    "with EMPTY lockset; previous write by thread "
+                    f"{prev_writer} at [{prev_site or '<locked write, stack elided>'}]; "
+                    f"first write by thread {st.owner} at [{st.first_site}]"
+                )
+
+    def reset_fields(self, obj) -> None:
+        """Hand-off point: forget this instance's field states so the
+        next writer starts a fresh Exclusive epoch (the caller asserts a
+        happens-before edge — ledger activate, request completion)."""
+        try:
+            s = getattr(obj, "_race_serial", None)
+        except Exception:  # noqa: BLE001
+            return
+        if s is None:
+            return
+        with self._mu:
+            per = self._fields.pop(s, None)
+            if per:
+                self._field_count -= len(per)
+
     # -- reporting ----------------------------------------------------------
 
     def inversions(self) -> List[str]:
         with self._mu:
             return list(self._inversions)
 
+    def races(self) -> List[str]:
+        with self._mu:
+            return list(self._races)
+
     def edges(self) -> Dict[Tuple[str, str], str]:
         with self._mu:
             return dict(self._order)
+
+
+class _FieldState:
+    """Lockset state for ONE field of ONE instance (keyed by the
+    instance's monotonic serial — ids recycle, serials don't)."""
+
+    __slots__ = (
+        "owner", "first_site", "last_writer", "last_site",
+        "lockset", "shared", "reported",
+    )
+
+    def __init__(self, owner: int, first_site: str) -> None:
+        self.owner = owner            # first writer's thread id
+        self.first_site = first_site
+        self.last_writer = owner
+        self.last_site: Optional[str] = None
+        self.lockset: FrozenSet = frozenset()
+        self.shared = False
+        self.reported = False
+
+
+_fields_metric = None
+
+
+def _bump_fields_metric() -> None:
+    global _fields_metric
+    if _fields_metric is None:
+        from dgraph_tpu.utils.metrics import RACE_WITNESS_FIELDS
+        _fields_metric = RACE_WITNESS_FIELDS
+    _fields_metric.add(1)
 
 
 # -- wrapper primitives -----------------------------------------------------
@@ -316,6 +515,9 @@ def arm() -> Witness:
             _patched.append((mod, "threading", cur))
             mod.threading = proxy
     _instrument_rwlock(w)
+    if races_enabled():
+        _instrument_race_classes()
+        _instrument_handoffs()
     return w
 
 
@@ -326,6 +528,19 @@ def disarm() -> None:
     for obj, attr, orig in _patched:
         setattr(obj, attr, orig)
     _patched.clear()
+    for cls, own_setattr in _race_patched:
+        if own_setattr is not None:
+            cls.__setattr__ = own_setattr
+        else:
+            try:
+                del cls.__setattr__
+            except AttributeError:  # pragma: no cover
+                pass
+        try:
+            del cls._race_instrumented
+        except AttributeError:  # pragma: no cover
+            pass
+    _race_patched.clear()
     if _global is not None:
         _global.active = False
         _global = None
@@ -383,3 +598,105 @@ def _instrument_rwlock(w: Witness) -> None:
     _rw.RWLock.acquire_write = make("acquire_write", True)
     _rw.RWLock.release_read = make("release_read", False)
     _rw.RWLock.release_write = make("release_write", False)
+
+
+# -- Eraser instrumentation (tier 3) ----------------------------------------
+
+# (cls, its own pre-wrap __setattr__ or None if it inherited object's)
+_race_patched: List[Tuple[type, Optional[object]]] = []
+
+
+def _instrument_race_classes() -> None:
+    """Wrap ``__setattr__`` on every loaded class declaring
+    ``__race_fields__``.  Installed at arm time ONLY: before arming (and
+    after disarm) annotated classes keep the original slot/dict setattr
+    — the unarmed serving path pays nothing and allocates nothing."""
+    for name, mod in list(sys.modules.items()):
+        if mod is None or not name.startswith("dgraph_tpu"):
+            continue
+        if any(name.startswith(e) for e in _EXCLUDE_MODULES):
+            continue
+        for obj in list(vars(mod).values()):
+            if isinstance(obj, type) and "__race_fields__" in vars(obj):
+                _instrument_one_class(obj)
+
+
+def _instrument_one_class(cls: type) -> None:
+    if vars(cls).get("_race_instrumented"):
+        return
+    fields = frozenset(vars(cls)["__race_fields__"])
+    own = vars(cls).get("__setattr__")
+    orig = cls.__setattr__  # resolved: own override or object/slot setattr
+
+    def __setattr__(self, name, value, _orig=orig, _fields=fields):
+        _orig(self, name, value)
+        if name in _fields:
+            wit = _global
+            if wit is not None and wit.active:
+                wit.note_field_write(self, name)
+
+    cls.__setattr__ = __setattr__
+    cls._race_instrumented = True
+    _race_patched.append((cls, own))
+
+
+def _instrument_handoffs() -> None:
+    """Wrap the hand-off points that establish happens-before edges for
+    the pooled ledger: ``activate`` (flush worker takes ownership) and
+    ``SchedRequest.complete/fail`` (``req.wait()`` releases the blocked
+    handler, which owns the struct from then on).  Each wrap resets the
+    struct's field states — a new Exclusive epoch for the new owner."""
+    led = sys.modules.get("dgraph_tpu.obs.ledger")
+    if led is not None and not getattr(led.activate, "_race_wrap", False):
+        orig_activate = led.activate
+
+        def activate(l, _orig=orig_activate):  # noqa: E741 — ledger arg
+            wit = _global
+            if wit is not None and wit.active:
+                wit.reset_fields(l)
+            return _orig(l)
+
+        activate._race_wrap = True
+        led.activate = activate
+        _patched.append((led, "activate", orig_activate))
+    if led is not None and not getattr(led.finish, "_race_wrap", False):
+        # finish() drains + resets + recycles through the pool: the end
+        # of the struct's life under this request.  Reset BEFORE the
+        # original so finish's own reset() stores open a fresh epoch
+        # owned by the draining thread, and the next start()'s tenant
+        # write — which lands before activate() can reset — reads as
+        # the tolerated pool hand-off, not a ping-pong with the
+        # previous request's writers.
+        orig_finish = led.finish
+
+        def finish(l, _orig=orig_finish):  # noqa: E741 — ledger arg
+            wit = _global
+            if wit is not None and wit.active:
+                wit.reset_fields(l)
+            return _orig(l)
+
+        finish._race_wrap = True
+        led.finish = finish
+        _patched.append((led, "finish", orig_finish))
+
+    coh = sys.modules.get("dgraph_tpu.sched.cohort")
+    if coh is not None:
+        for meth in ("complete", "fail"):
+            orig = getattr(coh.SchedRequest, meth)
+            if getattr(orig, "_race_wrap", False):
+                continue
+
+            def _make(o):
+                def wrapped(self, *a, **k):
+                    wit = _global
+                    if wit is not None and wit.active:
+                        led_obj = getattr(self, "ledger", None)
+                        if led_obj is not None:
+                            wit.reset_fields(led_obj)
+                    return o(self, *a, **k)
+
+                wrapped._race_wrap = True
+                return wrapped
+
+            setattr(coh.SchedRequest, meth, _make(orig))
+            _patched.append((coh.SchedRequest, meth, orig))

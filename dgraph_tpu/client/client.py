@@ -310,7 +310,10 @@ class DgraphClient:
                 try:
                     self._submit([], dels)
                 except BaseException as e:  # noqa: BLE001
-                    self._err = e
+                    # several workers can fail at once: publish the
+                    # error under the client lock, not as a bare store
+                    with self._lock:
+                        self._err = e
                 finally:
                     for _ in dels:
                         self._del_q.task_done()
@@ -322,7 +325,8 @@ class DgraphClient:
             try:
                 self._submit(sets, [])
             except BaseException as e:  # noqa: BLE001
-                self._err = e
+                with self._lock:
+                    self._err = e
             finally:
                 for _ in sets:
                     self._set_q.task_done()

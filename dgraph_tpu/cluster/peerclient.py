@@ -120,8 +120,16 @@ class StaleUnavailableError(OSError):
 class _PeerState:
     __slots__ = (
         "failures", "state", "gate",
-        "last_success", "last_failure", "total_failures",
+        "last_success", "last_failure", "total_failures", "_race_serial",
     )
+
+    # graftcheck tier 3: breaker counters and state transitions must
+    # all carry PeerClient._lock — the armed lockset witness proves the
+    # "mutated under PeerClient._lock" comment below stays true
+    __race_fields__ = frozenset({
+        "failures", "state", "last_success", "last_failure",
+        "total_failures",
+    })
 
     def __init__(self):
         self.failures = 0           # consecutive transport failures

@@ -977,6 +977,14 @@ class ArenaManager:
     stalls only readers of that same predicate.
     """
 
+    # graftcheck tier 3: the LRU accounting and the full-store-clear
+    # generation are bumped from every query thread — the witness holds
+    # them to the _cache_lock discipline the docstring above promises.
+    # expand_device_min is deliberately NOT listed: it is a GIL-atomic
+    # planner knob (engine setter rebinds an int; readers take either
+    # value and both are valid plans).
+    __race_fields__ = frozenset({"_lru_total", "_inval_gen_star"})
+
     def __init__(
         self,
         store: PostingStore,

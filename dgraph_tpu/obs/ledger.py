@@ -89,7 +89,29 @@ class Ledger:
         "device_sync_ms", "bytes_h2d", "bytes_d2h", "compiles",
         "cache_hits", "cache_misses", "cache_hit_bytes", "repairs",
         "coalesced", "exchange_bytes", "mesh_ms", "mesh_chips",
+        "_race_serial",
     )
+
+    # graftcheck tier 3: the pooled ledger is the engine's flagship
+    # single-writer hand-off — the lockset witness tracks every scalar
+    # slot, and the arm-time wraps on activate()/SchedRequest.complete/
+    # fail reset the epoch at exactly the happens-before edges this
+    # class's contract names (handler -> flush worker -> handler).
+    # ``hops`` is a dict (item writes bypass __setattr__) and is
+    # covered by the same epochs as the scalars it travels with.
+    # ``compiles`` is deliberately NOT listed: the jax.monitoring
+    # compile listener (obs/device.py) increments it from whichever
+    # engine-pool thread triggered the compile, concurrently with the
+    # request thread — a lost increment costs one count in a per-
+    # request diagnostic (the process-wide dgraph_xla_compiles_total
+    # twin is locked), and any guard here would be an import-time lock
+    # the witness cannot see.
+    __race_fields__ = frozenset({
+        "tenant", "edges", "host_ms", "device_ms", "device_sync_ms",
+        "bytes_h2d", "bytes_d2h", "cache_hits",
+        "cache_misses", "cache_hit_bytes", "repairs", "coalesced",
+        "exchange_bytes", "mesh_ms", "mesh_chips",
+    })
 
     def __init__(self):
         LEDGERS_CREATED.add(1)

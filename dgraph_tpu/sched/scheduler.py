@@ -121,6 +121,14 @@ def sched_enabled() -> bool:
 class CohortScheduler:
     """Owns the admission queues and the flush loop for one server."""
 
+    # graftcheck tier 3: the armed lockset witness checks every write to
+    # these scalars carries _cond (analysis/witness.py; the adaptive
+    # knobs are the seeded regression — they were once bare stores)
+    __race_fields__ = frozenset({
+        "_depth", "_flushes", "_last_arrival", "_stopped",
+        "max_batch", "flush_s",
+    })
+
     def __init__(
         self,
         server,
@@ -744,10 +752,7 @@ class CohortScheduler:
                 )
                 flush_span.finish()
             # feed this flush's measurements back: occupancy, the worst
-            # queue wait, and the cohort's service time.  The values are
-            # bounded by the controller; plain attribute stores are
-            # GIL-atomic for _next_cohort's reads, and responses never
-            # depend on either knob
+            # queue wait, and the cohort's service time
             self._adapt(len(cohort.reqs), max_wait, time.monotonic() - now)
 
     def _adapt(self, occupancy: int, max_wait: float, service_s: float) -> None:
@@ -764,7 +769,11 @@ class CohortScheduler:
             mb, fs = self._adaptive.update(occupancy, max_wait, service_s)
         else:
             mb, fs = self._adaptive.base_batch, self._adaptive.base_flush_s
-        self.max_batch, self.flush_s = mb, fs
+        # both knobs move together and _next_cohort reads them under
+        # _cond: with several flush workers, unlocked stores here could
+        # publish one worker's max_batch with another's flush_s
+        with self._cond:
+            self.max_batch, self.flush_s = mb, fs
 
     def _complete_follower(
         self, req, lead, merger, have_engine_lock: bool = False

@@ -42,6 +42,11 @@ jax.config.update("jax_platforms", "cpu")
 #    observing both (A before B) and (B before A) anywhere in the run
 #    fails the session.  DGRAPH_TPU_WITNESS=0 disables (e.g. when
 #    bisecting a perf delta).
+# 3. Eraser lockset witness (graftcheck tier 3): classes declaring
+#    __race_fields__ get __setattr__-wrapped at arm time; a multi-thread
+#    field written with an empty candidate lockset is a data race and
+#    fails the session like an inversion.  Co-gated: DGRAPH_TPU_WITNESS=0
+#    disarms both, DGRAPH_TPU_RACES=0 disarms just the lockset half.
 # ---------------------------------------------------------------------------
 
 from dgraph_tpu.analysis import witness as _witness  # noqa: E402
@@ -98,13 +103,26 @@ def pytest_terminal_summary(terminalreporter):
             )
             for line in inv:
                 terminalreporter.write_line("  " + line, red=True)
+        races = w.races()
+        if races:
+            terminalreporter.write_line("")
+            terminalreporter.write_line(
+                "DATA RACES OBSERVED (Eraser lockset witness):",
+                red=True,
+            )
+            for line in races:
+                terminalreporter.write_line("  " + line, red=True)
 
 
 def pytest_sessionfinish(session, exitstatus):
     w = _witness.current()
-    if w is not None and w.inversions() and session.exitstatus == 0:
-        # an inversion is a deadlock waiting for the right interleaving:
-        # fail the run even when every individual test passed
+    if w is not None and session.exitstatus == 0 and (
+        w.inversions() or w.races()
+    ):
+        # an inversion is a deadlock waiting for the right interleaving,
+        # and an empty-lockset multi-thread write is a torn read waiting
+        # for the wrong one: fail the run even when every individual
+        # test passed
         session.exitstatus = 1
     now = (
         hashlib.sha1(_GOLDENS.read_bytes()).hexdigest()

@@ -1613,3 +1613,441 @@ def test_naked_device_sync_ships_clean_on_tree():
         repo_root=str(root),
     )
     assert findings == [], [f"{f.path}:{f.line}" for f in findings]
+
+
+# ------------------------------------------- tier 3: static escape analysis
+
+from dgraph_tpu.analysis.escape import (  # noqa: E402
+    RULE_ESCAPE,
+    RULE_GLOBAL,
+    RULE_WHY,
+    check_escape_source,
+    check_escapes,
+)
+from dgraph_tpu.analysis.lockorder import discover_thread_entries  # noqa: E402
+
+
+def test_escape_two_thread_unlocked_write_flagged():
+    """The golden bad: a field written by a spawned thread AND a public
+    method, neither under a lock."""
+    src = textwrap.dedent("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self.count = 0
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                while True:
+                    self.count += 1
+
+            def poke(self):
+                self.count = 0
+    """)
+    findings = check_escape_source(src)
+    assert [f.rule for f in findings] == [RULE_ESCAPE]
+    assert "count" in findings[0].message
+
+
+def test_escape_locked_writes_clean():
+    src = textwrap.dedent("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                with self._lock:
+                    self.count += 1
+
+            def poke(self):
+                with self._lock:
+                    self.count = 0
+    """)
+    assert check_escape_source(src) == []
+
+
+def test_escape_single_root_clean():
+    """A field only the spawned thread writes (init writes are
+    happens-before the spawn and stripped) is single-writer."""
+    src = textwrap.dedent("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self.count = 0
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self.count += 1
+    """)
+    assert check_escape_source(src) == []
+
+
+def test_escape_caller_holds_lock_clean():
+    """The `caller holds self._lock` discipline: a private helper whose
+    every call site is under the lock inherits the lock scope (the
+    devguard _set_state shape)."""
+    src = textwrap.dedent("""
+        import threading
+
+        class Guard:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = "ok"
+                threading.Thread(target=self._probe, daemon=True).start()
+
+            def _set_state(self, s):
+                self.state = s
+
+            def _probe(self):
+                with self._lock:
+                    self._set_state("degraded")
+
+            def readmit(self):
+                with self._lock:
+                    self._set_state("ok")
+    """)
+    assert check_escape_source(src) == []
+
+
+def test_escape_pragma_sanctions_with_why_and_flags_without():
+    base = textwrap.dedent("""
+        import threading
+
+        class Flag:
+            def __init__(self):
+                self.done = False
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                {pragma}
+                self.done = True
+
+            def stop(self):
+                self.done = False
+    """)
+    why = base.format(
+        pragma="# graftlint: shared[done] GIL-atomic bool handshake, "
+        "single store each side"
+    )
+    assert check_escape_source(why) == []
+    bare = base.format(pragma="# graftlint: shared[done]")
+    rules = sorted(f.rule for f in check_escape_source(bare))
+    # sanctioned (no thread-escape) but the missing WHY is itself flagged
+    assert rules == [RULE_WHY]
+
+
+def test_escape_executor_submit_is_a_thread_root():
+    """Satellite: ThreadPoolExecutor.submit and bound-method
+    Thread(target=self.x) feed one shared entry model — submit inside a
+    loop counts as many threads, so one method alone races with itself."""
+    src = textwrap.dedent("""
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Fan:
+            def __init__(self):
+                self.done = 0
+                self._ex = ThreadPoolExecutor(4)
+
+            def kick(self):
+                for _ in range(4):
+                    self._ex.submit(self._work)
+
+            def _work(self):
+                self.done += 1
+    """)
+    findings = check_escape_source(src)
+    assert [f.rule for f in findings] == [RULE_ESCAPE]
+    assert "done" in findings[0].message
+
+
+def test_escape_conn_handler_instances_exempt_globals_still_flagged():
+    """Per-connection handler instances are single-threaded (fresh
+    instance per request) — but a module global they write is shared
+    across every concurrent connection."""
+    src = textwrap.dedent("""
+        from http.server import BaseHTTPRequestHandler
+
+        HITS = 0
+
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                global HITS
+                HITS += 1           # global-escape: concurrent handlers
+                self.body = b"ok"   # instance attr: per-connection, fine
+    """)
+    findings = check_escape_source(src)
+    assert [f.rule for f in findings] == [RULE_GLOBAL]
+    assert "HITS" in findings[0].message
+
+
+def test_escape_seeded_scheduler_adapt_shape():
+    """Regression seed for the PR-19 scheduler fix: two flush workers
+    (loop-spawned) rebinding adaptive knobs unlocked was the shipped
+    bug; the same stores under the condvar are the shipped fix."""
+    bug = textwrap.dedent("""
+        import threading
+
+        class Sched:
+            def __init__(self, n):
+                self._cond = threading.Condition()
+                self.max_batch = 8
+                for _ in range(n):
+                    threading.Thread(target=self._worker).start()
+
+            def _worker(self):
+                self._adapt()
+
+            def _adapt(self):
+                self.max_batch = 16
+    """)
+    findings = check_escape_source(bug)
+    assert [f.rule for f in findings] == [RULE_ESCAPE]
+    assert "max_batch" in findings[0].message
+    fixed = bug.replace(
+        "        self.max_batch = 16",
+        "        with self._cond:\n"
+        "            self.max_batch = 16",
+    )
+    assert fixed != bug
+    assert check_escape_source(fixed) == []
+
+
+def test_thread_entry_discovery_spellings():
+    import ast as _ast
+
+    src = textwrap.dedent("""
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        def loose():
+            pass
+
+        class S:
+            def __init__(self):
+                threading.Thread(target=self._run).start()
+                threading.Timer(1.0, self._tick).start()
+                with ThreadPoolExecutor(2) as ex:
+                    ex.submit(self._job)
+
+            def _run(self): pass
+            def _tick(self): pass
+            def _job(self): pass
+
+        # graftlint: thread-entry
+        def marked():
+            pass
+    """)
+    entries = discover_thread_entries(
+        _ast.parse(src), "m", "m.py", src.splitlines()
+    )
+    quals = {e.qual: e.kind for e in entries}
+    assert quals["m.S._run"] == "thread"
+    assert quals["m.S._tick"] == "timer"
+    assert quals["m.S._job"] == "executor"
+    assert quals["m.marked"] == "pragma"
+    assert "m.loose" not in quals
+
+
+def test_races_cli_nonzero_on_golden_bad_zero_on_tree(tmp_path):
+    from dgraph_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+
+        class P:
+            def __init__(self):
+                self.n = 0
+                threading.Thread(target=self.run).start()
+
+            def run(self):
+                self.n += 1
+
+            def poke(self):
+                self.n = 2
+    """))
+    assert main(["--races", str(bad)]) == 1
+    # acceptance: the shipped tree is clean with the EMPTY manifest
+    assert main(["--races"]) == 0
+
+
+def test_races_manifest_roundtrip(tmp_path):
+    """--write-shared adopts standing findings as a multiset baseline;
+    a NEW finding is not hidden behind it."""
+    from dgraph_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    one = textwrap.dedent("""
+        import threading
+
+        class P:
+            def __init__(self):
+                self.n = 0
+                threading.Thread(target=self.run).start()
+
+            def run(self):
+                self.n += 1
+
+            def poke(self):
+                self.n = 2
+    """)
+    bad.write_text(one)
+    manifest = tmp_path / "shared.json"
+    assert main(["--races", str(bad), "--write-shared", str(manifest)]) == 0
+    assert main(["--races", str(bad), "--shared-manifest", str(manifest)]) == 0
+    bad.write_text(one + textwrap.dedent("""
+        class Q:
+            def __init__(self):
+                self.m = 0
+                threading.Thread(target=self.run).start()
+
+            def run(self):
+                self.m += 1
+
+            def poke(self):
+                self.m = 2
+    """))
+    assert main(
+        ["--races", str(bad), "--shared-manifest", str(manifest)]
+    ) == 1
+
+
+# --------------------------------------- tier 3: Eraser lockset witness
+
+class _Obj:
+    """A bare field-state carrier for driving note_field_write directly."""
+
+
+def _in_thread(fn):
+    th = threading.Thread(target=fn)
+    th.start()
+    th.join()
+
+
+def test_lockset_witness_catches_seeded_two_thread_race():
+    w = witness_mod.Witness()
+    o = _Obj()
+    w.note_field_write(o, "x")          # this thread: Virgin -> Exclusive
+    _in_thread(lambda: w.note_field_write(o, "x"))  # hand-off: tolerated
+    assert w.races() == []
+    w.note_field_write(o, "x")          # ping-pong back: the race
+    races = w.races()
+    assert len(races) == 1 and "_Obj.x" in races[0]
+    assert "EMPTY lockset" in races[0]
+    # one report per field, not one per write
+    _in_thread(lambda: w.note_field_write(o, "x"))
+    assert len(w.races()) == 1
+
+
+def test_lockset_witness_single_writer_handoff_exempt():
+    """Init-then-publish: creator writes, one worker takes over and
+    keeps writing.  No alternation back — silent, even with no lock."""
+    w = witness_mod.Witness()
+    o = _Obj()
+    w.note_field_write(o, "x")
+    w.note_field_write(o, "x")
+
+    def worker():
+        for _ in range(3):
+            w.note_field_write(o, "x")
+
+    _in_thread(worker)
+    assert w.races() == []
+
+
+def test_lockset_witness_refines_to_common_lock():
+    """Writers sharing a lock stay clean indefinitely; a third writer
+    OUTSIDE the lock empties the intersection and is reported."""
+    w = witness_mod.Witness()
+    lk = witness_mod._WLock(w, "lock.L", threading.Lock())
+    o = _Obj()
+
+    def locked_write():
+        with lk:
+            w.note_field_write(o, "x")
+
+    locked_write()
+    _in_thread(locked_write)
+    locked_write()
+    _in_thread(locked_write)
+    assert w.races() == []
+    _in_thread(lambda: w.note_field_write(o, "x"))
+    races = w.races()
+    assert len(races) == 1 and "_Obj.x" in races[0]
+
+
+def test_lockset_witness_reset_fields_is_an_epoch():
+    """reset_fields asserts a happens-before edge (ledger activation,
+    request completion): the ping-pong that would otherwise report is
+    split into two clean single-writer epochs."""
+    w = witness_mod.Witness()
+    o = _Obj()
+    w.note_field_write(o, "x")
+    _in_thread(lambda: w.note_field_write(o, "x"))
+    w.reset_fields(o)
+    w.note_field_write(o, "x")
+    _in_thread(lambda: w.note_field_write(o, "x"))
+    assert w.races() == []
+
+
+def test_race_instrumentation_is_arm_time_only(monkeypatch):
+    """Unarmed classes carry only the frozenset — no __setattr__ in the
+    class dict, no per-write work.  _instrument_one_class installs the
+    wrapper, writes feed the active witness, and the uninstrumented
+    original stays restorable."""
+
+    class Box:
+        __race_fields__ = frozenset({"v"})
+
+        def __init__(self):
+            self.v = 0
+
+    assert "__setattr__" not in vars(Box)  # unarmed: nothing installed
+    fresh = witness_mod.Witness()
+    monkeypatch.setattr(witness_mod, "_global", fresh)
+    witness_mod._instrument_one_class(Box)
+    assert vars(Box).get("_race_instrumented") is True
+    witness_mod._instrument_one_class(Box)  # idempotent
+    b = Box()
+    _in_thread(lambda: setattr(b, "v", 1))  # hand-off
+    b.v = 2                                 # ping-pong: race
+    races = fresh.races()
+    assert len(races) == 1 and "Box.v" in races[0]
+
+
+def test_shipped_race_annotations_are_instrumented_and_consistent():
+    """The suite runs with the witness armed (conftest): every shipped
+    __race_fields__ class must actually be wrapped, and every annotated
+    name must be a real slot where __slots__ is declared (a typo'd name
+    would silently witness nothing)."""
+    if not witness_mod.races_enabled() or witness_mod.current() is None:
+        pytest.skip("witness disarmed for this run")
+    from dgraph_tpu.cluster.peerclient import _PeerState
+    from dgraph_tpu.ivm.deltas import DeltaStream
+    from dgraph_tpu.obs.ledger import Ledger
+    from dgraph_tpu.sched.qos import CancelToken
+    from dgraph_tpu.sched.scheduler import CohortScheduler
+    from dgraph_tpu.models.arena import ArenaManager
+    from dgraph_tpu.utils.devguard import DeviceGuard, _Job
+
+    # re-arm: when this file runs alone, the imports above happened
+    # AFTER the per-test arm — the same lazy-import window the conftest
+    # re-arm comment describes
+    witness_mod.arm()
+    for cls in (
+        ArenaManager,
+        _PeerState, DeltaStream, Ledger, CancelToken,
+        CohortScheduler, DeviceGuard, _Job,
+    ):
+        assert vars(cls).get("_race_instrumented") is True, cls
+        slots = getattr(cls, "__slots__", None)
+        if slots is not None:
+            missing = set(cls.__race_fields__) - set(slots)
+            assert not missing, (cls, missing)
+            assert "_race_serial" in slots, cls
