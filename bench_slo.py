@@ -708,6 +708,152 @@ def run_devfault_arm(store, rates, secs, workers, seed) -> dict:
     return out
 
 
+def run_meshchaos_arm(store, rates, secs, workers, seed) -> dict:
+    """Open-loop p50/p99/p999 + shed rate across ONE injected chip-loss
+    → staged-rejoin cycle on the elastic mesh fault domain (PR 20).
+
+    Mesh backend only: halfway through the middle offered-load step the
+    ``device.mesh`` failpoint kills chip ``SLO_MESHCHAOS_CHIP`` (seeded
+    by DGRAPH_TPU_FAILPOINT_SEED, so the cycle is reproducible); the
+    domain re-shards onto the surviving sub-mesh in-band, the short
+    ``SLO_MESHCHAOS_COOLDOWN_S`` probe re-admits the chip, and the
+    warm-then-cutover rejoin restores the full-mesh epoch — all while
+    the open-loop schedule keeps firing.  The steps record the latency
+    and shed cost of the whole cycle; the cycle record proves it
+    actually closed (loss + rejoin reshards, full width restored, zero
+    surfaced errors)."""
+    from dgraph_tpu.utils import devguard
+    from dgraph_tpu.utils.failpoints import fail
+    from dgraph_tpu.utils.metrics import MESH_RESHARD, QUERY_RESUMED
+
+    if _backend_arg() != "mesh":
+        return {"skipped": "meshchaos arm runs under --backend mesh only"}
+    import jax
+
+    if len(jax.devices()) < 2:
+        return {"skipped": "meshchaos arm needs a multi-chip mesh"}
+    chip = int(_env_f("SLO_MESHCHAOS_CHIP", 1))
+    cooldown = _env_f("SLO_MESHCHAOS_COOLDOWN_S", 1.0)
+    rng = np.random.default_rng(seed + 9000)
+    n_nodes = int(_env_f("SLO_NODES", 20_000))
+    pool = []
+    # pool size is tunable: each distinct query is a compile candidate
+    # and the arm warms the pool at BOTH mesh widths — CPU-mesh smoke
+    # runs want a handful, a TPU bench round wants the full spread
+    for _ in range(int(_env_f("SLO_MESHCHAOS_POOL", 64))):
+        seeds = np.unique(rng.integers(1, n_nodes + 1, size=16))
+        ul = ", ".join("0x%x" % u for u in seeds)
+        pool.append("{ q(func: uid(%s)) { e { e { c: count(e) } } } }" % ul)
+    inject_step = len(rates) // 2
+    fp_seed = int(os.environ.get("DGRAPH_TPU_FAILPOINT_SEED", "0"))
+    fail.reset(fp_seed)
+    out = {"chip": chip, "cooldown_s": cooldown}
+    with _ServerArm(store, {
+        "DGRAPH_TPU_SCHED": "1",
+        "DGRAPH_TPU_CACHE": "0",
+        "DGRAPH_TPU_DEVGUARD": "1",
+        "DGRAPH_TPU_DEVICE_COOLDOWN_S": f"{cooldown:g}",
+        "DGRAPH_TPU_EXPAND_DEVICE_MIN": "1",
+        **_backend_env(),
+    }) as srv:
+        devguard.reset_for_tests()
+        dom = getattr(srv.engine.arenas, "mesh_fault", None)
+        if dom is None:
+            return {
+                "skipped": "mesh fault domain off "
+                "(DGRAPH_TPU_MESH_ELASTIC=0 or single-chip mesh)"
+            }
+        total = len(dom.devices)
+        classes = [
+            {"name": "khop", "rate": 0.0, "pool": pool, "tenant": ""}
+        ]
+        # warm BOTH widths and the rejoin path before measuring: full
+        # mesh first, then a throwaway loss→rejoin cycle so the
+        # injected step never pays first-time sub-mesh XLA compiles
+        # (a cold compile is slow, not lost capacity)
+        _warmup(srv.port, classes, n=len(pool))
+        fail.arm("device.mesh", f"error(n=1,chip={chip})")
+        _warmup(srv.port, classes, n=len(pool))
+        deadline = time.monotonic() + 30.0
+        while dom.width < total and time.monotonic() < deadline:
+            time.sleep(0.1)
+        if dom.width < total:
+            return {
+                "skipped": "warmup loss→rejoin cycle never converged: "
+                + json.dumps(dom.status())
+            }
+        fail.reset(fp_seed)
+        rs0 = dict(MESH_RESHARD.snapshot())
+        qr0 = dict(QUERY_RESUMED.snapshot())
+        epoch0 = dom.epoch
+        steps = []
+        for step_i, rate in enumerate(rates):
+            classes[0]["rate"] = rate
+            injected = step_i == inject_step
+            timer = None
+            if injected:
+                timer = threading.Timer(
+                    secs / 2.0,
+                    lambda: fail.arm(
+                        "device.mesh", f"error(n=1,chip={chip})"
+                    ),
+                )
+                timer.start()
+            try:
+                step = open_loop_step(
+                    srv.port, classes, secs, seed + 9100 + step_i,
+                    workers,
+                )
+            finally:
+                if timer is not None:
+                    timer.cancel()
+            k = step["classes"]["khop"]
+            steps.append({
+                "offered_qps": step["offered_qps"],
+                "achieved_qps": step["achieved_qps"],
+                "p50_ms": k["p50_ms"],
+                "p99_ms": k["p99_ms"],
+                "p999_ms": k["p999_ms"],
+                "shed_rate": step["shed_rate"],
+                "error_rate": step["error_rate"],
+                "injected": injected,
+                "epoch": dom.epoch,
+                "chips_healthy": dom.width,
+            })
+            print(
+                f"# slo meshchaos offered={rate} p999={k['p999_ms']}ms "
+                f"width={dom.width}/{total}"
+                + (" (chip loss injected)" if injected else ""),
+                file=sys.stderr,
+            )
+        # the cycle must CLOSE: bounded poll for the staged rejoin
+        deadline = time.monotonic() + 30.0
+        while dom.width < total and time.monotonic() < deadline:
+            time.sleep(0.1)
+        rs = {
+            k: v - rs0.get(k, 0)
+            for k, v in MESH_RESHARD.snapshot().items()
+        }
+        qr = {
+            k: v - qr0.get(k, 0)
+            for k, v in QUERY_RESUMED.snapshot().items()
+        }
+        out.update({
+            "steps": steps,
+            "cycle": {
+                "restored": dom.width == total,
+                "chips_total": total,
+                "epoch_before": epoch0,
+                "epoch_after": dom.epoch,
+                "reshards": rs,
+                "resumed": qr,
+            },
+        })
+    fail.reset(fp_seed)
+    devguard.reset_for_tests()
+    return out
+
+
 # every device dispatch seam the mega-query may route through: the
 # planner picks chain vs mask-chain vs multi-hop per store shape, and
 # the arm must price the dispatch wherever it lands
@@ -978,6 +1124,15 @@ def run_slo_bench() -> dict:
             seg = run_seg_arm(store, secs, workers, seed)
         except Exception as e:
             seg = {"error": f"{type(e).__name__}: {e}"}
+    meshchaos = None
+    if os.environ.get("SLO_MESHCHAOS", "1") != "0":
+        try:
+            meshchaos = run_meshchaos_arm(
+                store, _env_rates("SLO_MESHCHAOS_RATES", "20,40"), secs,
+                workers, seed,
+            )
+        except Exception as e:
+            meshchaos = {"error": f"{type(e).__name__}: {e}"}
 
     from dgraph_tpu.obs import ledger as _ledgermod
 
@@ -998,6 +1153,7 @@ def run_slo_bench() -> dict:
         "ivm": ivm,
         "devfault": devfault,
         "seg": seg,
+        "meshchaos": meshchaos,
         # the serving-path cost account for the whole run (obs/ledger.py):
         # edges/sec across the sweep is achieved_qps × edges-per-query,
         # and this is the series it reconciles against
@@ -1054,6 +1210,29 @@ def smoke_check(out: dict) -> None:
         assert inj_off["p999_ms"] >= dv["wedge_ms"] * 0.6, (
             "devfault smoke: guard-off arm never observed the wedge"
         )
+    mc = out.get("meshchaos")
+    if mc and "error" not in mc and "skipped" not in mc:
+        cyc = mc["cycle"]
+        assert cyc["restored"], (
+            "meshchaos smoke: staged rejoin never restored the full mesh"
+        )
+        assert cyc["reshards"].get("loss", 0) >= 1, (
+            "meshchaos smoke: the injected loss never drove a reshard"
+        )
+        assert cyc["reshards"].get("rejoin", 0) >= 1, (
+            "meshchaos smoke: no rejoin cutover was recorded"
+        )
+        assert cyc["epoch_after"] > cyc["epoch_before"], (
+            "meshchaos smoke: the mesh epoch never advanced"
+        )
+        for s in mc["steps"]:
+            # chip loss is CAPACITY, not errors: the whole cycle —
+            # loss, degraded sub-mesh serving, rejoin cutover — must
+            # surface zero non-shed errors
+            assert s["error_rate"] == 0.0, (
+                f"meshchaos smoke: surfaced errors at "
+                f"offered={s['offered_qps']}"
+            )
     sg = out.get("seg")
     if sg and "error" not in sg:
         on, off = sg["seg_on"], sg["seg_off"]

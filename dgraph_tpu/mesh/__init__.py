@@ -17,13 +17,20 @@ that makes those kernels a first-class serving backend:
   ``DeviceExpander``/``chain`` as the planner-priced ``route:mesh``,
   devguard-bracketed under the "mesh" fault domain and ledger-charged
   (per-chip device time + exchange bytes).
+- :mod:`dgraph_tpu.mesh.fault` — ``MeshFaultDomain`` (PR 20): the
+  elastic fault domain that turns chip loss into a CAPACITY event —
+  per-chip devguard sub-domains, epoch-fenced re-sharding onto the
+  surviving sub-mesh, drain-and-resume for in-flight segmented
+  queries, and warm-then-cutover staged rejoin of healed chips.
 
 ``DGRAPH_TPU_MESH`` tri-state (serve/server.py::_auto_mesh): "0"/"off"
 never (byte-identical unsharded serving), "1"/"auto"/unset on when >1
-device is visible, "force" always.
+device is visible, "force" always.  ``DGRAPH_TPU_MESH_ELASTIC=0``
+keeps the mesh but restores the PR 17 whole-plane fault latch.
 """
 
 from dgraph_tpu.mesh.executor import MeshExecutor
+from dgraph_tpu.mesh.fault import MeshFaultDomain
 from dgraph_tpu.mesh.plan import MeshPlan
 
-__all__ = ["MeshExecutor", "MeshPlan"]
+__all__ = ["MeshExecutor", "MeshFaultDomain", "MeshPlan"]

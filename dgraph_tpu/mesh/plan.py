@@ -41,6 +41,21 @@ def plan_path() -> str:
     return os.environ.get("DGRAPH_TPU_MESH_PLAN", "")
 
 
+def _greedy_pack(order, n_shards: int):
+    """The one greedy bin-pack (biggest predicate first, least-loaded
+    chip): ``rebalance`` commits its result, ``preview`` only looks.
+    Shared so the two can never disagree — the staged rejoin warms
+    shards under preview's offsets and relies on the cutover rebalance
+    reproducing them exactly."""
+    load = [0] * n_shards
+    placement: Dict[str, int] = {}
+    for pred, nb in order:
+        off = min(range(n_shards), key=lambda i: load[i])
+        placement[pred] = off
+        load[off] += nb
+    return placement, load
+
+
 class MeshPlan:
     """Predicate→start-shard placement over an ``n_shards``-wide model
     axis.  Thread-safe: the serving layer places from concurrent read
@@ -80,6 +95,13 @@ class MeshPlan:
         assigned chip.  Offset 0 (and a 1-wide mesh) returns the input
         untouched — the staged arrays never copy for the common case."""
         off = self.offset_for(pred, sharded.device_bytes()) % self.n_shards
+        return self.rolled(sharded, off)
+
+    @staticmethod
+    def rolled(sharded, off: int):
+        """Apply one start offset to a freshly built ``ShardedArena``
+        (shared with the staged-rejoin warm path, which rolls under a
+        PREVIEWED placement before the plan itself re-targets)."""
         if off == 0:
             return sharded
         import jax.numpy as jnp
@@ -93,23 +115,40 @@ class MeshPlan:
             n_shards=sharded.n_shards,
         )
 
-    def rebalance(self) -> Dict[str, int]:
+    def preview(self, n_shards: int) -> Dict[str, int]:
+        """The placement ``rebalance(n_shards=n)`` WOULD commit, without
+        touching the plan: the staged rejoin (mesh/fault.py) warms
+        sharded views under the candidate width's offsets so the
+        post-cutover rebalance finds them already valid.  Greedy is
+        deterministic — same recorded bytes + same width ⇒ same
+        offsets — which is the whole contract here."""
+        with self._lock:
+            order = sorted(self._bytes.items(), key=lambda kv: -kv[1])
+        placement, _load = _greedy_pack(order, max(1, int(n_shards)))
+        return placement
+
+    def rebalance(self, n_shards: Optional[int] = None) -> Dict[str, int]:
         """Re-place everything seen so far, biggest predicate first
         (greedy bin-pack by recorded device bytes).  Returns the new
         placement; the version bump invalidates cached sharded arenas
-        (ArenaManager keys the cache on it)."""
+        (ArenaManager keys the cache on it).
+
+        ``n_shards`` re-targets the plan at a DIFFERENT model-axis
+        width — the elastic mesh fault domain's re-shard (mesh/fault.py):
+        chip loss packs everything onto the N−1 … 1 surviving chips,
+        staged rejoin widens back.  The version bump is the mesh EPOCH
+        FENCE — every dispatched mesh program carries the version it was
+        planned under, and an in-flight query observing a bump at a
+        segment seam re-plans its remaining hops under the new width."""
         with self._lock:
+            if n_shards is not None:
+                self.n_shards = max(1, int(n_shards))
             order = sorted(
                 self._bytes.items(), key=lambda kv: -kv[1]
             )
-            self._load = [0] * self.n_shards
-            self.placement = {}
-            for pred, nb in order:
-                off = min(
-                    range(self.n_shards), key=lambda i: self._load[i]
-                )
-                self.placement[pred] = off
-                self._load[off] += nb
+            self.placement, self._load = _greedy_pack(
+                order, self.n_shards
+            )
             self.version += 1
             self._save_locked()
             return dict(self.placement)

@@ -23,6 +23,13 @@ Memoized per (mesh, cap, n_hops) like every step in parallel/mesh.py:
 jax.jit caches on function identity, and caps ride ops.bucket so the
 program family stays bounded (analysis/budgets.json entries cap the
 compile count in CI).
+
+Elastic fault domain (PR 20): a ``Mesh`` hashes by its device set +
+axis names, so programs built here key cleanly per mesh EPOCH — an
+eviction re-shards onto a sub-mesh and compiles its own bounded
+family, and the staged rejoin's flip back to the memoized boot mesh
+hash-hits the original cache (zero recompiles; mesh/fault.py warms
+the candidate mesh's shapes BEFORE the cutover either way).
 """
 
 from __future__ import annotations

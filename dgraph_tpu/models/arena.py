@@ -995,8 +995,12 @@ class ArenaManager:
         self.store = store
         # device mesh for uid-range row sharding of big predicates (the
         # intra-predicate sharding the reference lacks, SURVEY.md §5);
-        # None = single-device execution
-        self.mesh = mesh
+        # None = single-device execution.  ``self.mesh`` is a property:
+        # with the elastic fault domain active it reads the CURRENT
+        # surviving sub-mesh, so every consumer (sharded_csr width,
+        # executor dispatch, scheduler concurrency) follows a re-shard
+        # through one swap.
+        self._mesh = mesh
         self.shard_threshold = shard_threshold
         # mesh serving plane (PR 17): predicate→shard placement so
         # co-resident predicates don't all pile shard 0 (their densest
@@ -1004,10 +1008,22 @@ class ArenaManager:
         # executor the engine/chain dispatch through
         self.mesh_plan = None
         self._mesh_exec = None
+        # elastic mesh fault domain (mesh/fault.py): per-chip health +
+        # epoch-fenced sub-mesh re-sharding.  Only meaningful when there
+        # is more than one chip to lose; DGRAPH_TPU_MESH_ELASTIC=0
+        # restores the PR 17 monolithic plane exactly.
+        self.mesh_fault = None
         if mesh is not None:
             from dgraph_tpu.mesh.plan import MeshPlan
 
             self.mesh_plan = MeshPlan.load(int(mesh.shape["model"]))
+            if int(mesh.shape["model"]) > 1:
+                from dgraph_tpu.mesh import fault as _mesh_fault
+
+                if _mesh_fault.elastic_enabled():
+                    self.mesh_fault = _mesh_fault.MeshFaultDomain(
+                        self, mesh
+                    )
         # single source of truth for host-vs-device expansion routing
         # (engine and FuncResolver both read it; engine may retune at
         # runtime) — see QueryEngine.__init__ for the rationale.  While
@@ -1440,6 +1456,19 @@ class ArenaManager:
 
     # -- mesh sharding -------------------------------------------------------
 
+    @property
+    def mesh(self):
+        """The CURRENT serving mesh: the boot mesh, or — when the
+        elastic fault domain has evicted a chip — the surviving
+        sub-mesh it re-sharded onto.  None = unsharded execution."""
+        if self.mesh_fault is not None:
+            return self.mesh_fault.mesh
+        return self._mesh
+
+    @mesh.setter
+    def mesh(self, m):
+        self._mesh = m
+
     def sharded_csr(self, pred: str, reverse: bool = False):
         """Row-sharded view of a predicate's CSR over the mesh's 'model'
         axis, cached against the source arena's identity (rebuilds follow
@@ -1465,6 +1494,10 @@ class ArenaManager:
 
         def valid(e):
             if e[0] is not a:
+                return False
+            # an elastic re-shard changed the model-axis width: the old
+            # width's rolls are unservable on the new sub-mesh
+            if e[1].n_shards != int(self.mesh.shape["model"]):
                 return False
             if self.mesh_plan is None:
                 return True
@@ -1508,6 +1541,62 @@ class ArenaManager:
             avg_deg = arena.n_edges / max(1, arena.n_rows)
             return should_shard(arena_bytes, arena.n_rows, avg_deg, n_model)
         return True
+
+    def drop_sharded(self) -> None:
+        """Drop every mesh-sharded view — the elastic re-shard's cache
+        surgery: the evicted width's rolls are dead weight on the new
+        sub-mesh, and survivors re-seed lazily through sharded_csr
+        under the same HBM budget/LRU (this IS the re-seeding
+        mechanism; no bulk re-upload)."""
+        with self._cache_lock:
+            for key in list(self._sharded):
+                self._sharded.pop(key, None)
+                self._lru_drop(self._sharded, key)
+
+    def warm_sharded(self, mesh):
+        """Pre-build sharded views at a rejoin CANDIDATE mesh's width —
+        the warm half of warm-then-cutover, run on the fault domain's
+        probe thread while live traffic keeps serving the current
+        sub-mesh.  Offsets come from the plan's ``preview`` of the
+        candidate width so the post-cutover ``rebalance`` finds the
+        adopted entries already valid.  Build failures propagate: an
+        unprovable warm means no cutover (the chip re-latches)."""
+        from dgraph_tpu.mesh.fault import StagedShards
+        from dgraph_tpu.mesh.plan import MeshPlan
+        from dgraph_tpu.parallel.mesh import shard_arena_rows
+
+        n_model = int(mesh.shape["model"])
+        staged = StagedShards(n_model)
+        with self._cache_lock:
+            keys = list(self._sharded)
+        preview = (
+            self.mesh_plan.preview(n_model)
+            if self.mesh_plan is not None
+            else {}
+        )
+        for pred, reverse in keys:
+            a = self.reverse(pred) if reverse else self.data(pred)
+            pkey = ("~" + pred) if reverse else pred
+            sa = shard_arena_rows(
+                a.h_src, a.h_offsets, a.host_dst(), n_model
+            )
+            off = preview.get(pkey, 0) % n_model
+            staged.views[(pred, reverse)] = (
+                a, MeshPlan.rolled(sa, off), off,
+            )
+        return staged
+
+    def adopt_sharded(self, staged) -> None:
+        """Cutover half of warm-then-cutover: install the staged views
+        built by :meth:`warm_sharded`, with LRU/budget accounting as if
+        each had just been built (a stage whose width no longer matches
+        the live mesh is the caller's to discard)."""
+        if self.mesh is None or int(self.mesh.shape["model"]) != staged.width:
+            return
+        with self._cache_lock:
+            for key, entry in staged.views.items():
+                self._sharded[key] = entry
+                self._touch((id(self._sharded), key), entry)
 
     # -- data / reverse ----------------------------------------------------
 

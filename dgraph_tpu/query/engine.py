@@ -828,6 +828,16 @@ class QueryEngine:
                         if s["state"] != "healthy" or s["faults"]
                     },
                 }
+            # elastic mesh fault domain (mesh/fault.py): a request served
+            # on a SURVIVING sub-mesh — or drained-and-resumed across an
+            # epoch flip — carries the epoch + capacity disclosure.  The
+            # results are byte-identical (placement invisibility +
+            # program parity contracts); only capacity is degraded.
+            # gRPC mirrors the epoch as a dgraph-mesh-epoch trailer.
+            if self.stats.get("mesh_degraded"):
+                out.setdefault("degraded", {})["mesh"] = dict(
+                    self.stats["mesh_degraded"]
+                )
         elif parsed.mutation is not None and "schema" not in out:
             out["code"] = "Success"
             out["message"] = "Done"

@@ -599,7 +599,8 @@ class CohortController:
         # idle behavior) stays put, width only raises how far sustained
         # load may push the cap before the 1024 clamp
         self.width = max(1, int(width))
-        self.hi_batch = min(self.base_batch * 8 * self.width, 1024)
+        self._clamp_cap = 1024
+        self.hi_batch = min(self.base_batch * 8 * self.width, self._clamp_cap)
         self.base_flush_s = float(base_flush_s)
         self.lo_flush_s = self.base_flush_s / 8.0
         self.max_batch = self.base_batch
@@ -609,6 +610,24 @@ class CohortController:
         self._service = 0.0
         self._updates = 0
         self._lock = threading.Lock()
+
+    def set_width(self, width: int) -> None:
+        """Re-target the batching ceiling at a NEW mesh width — the
+        elastic fault domain (mesh/fault.py) shrinks/widens the serving
+        sub-mesh at runtime, and the scheduler re-samples per flush.  A
+        shrink also clamps the live cap immediately (a 7-chip sub-mesh
+        must not keep draining cohorts sized for 8); growth lets the
+        ordinary occupancy rule climb back on its own evidence."""
+        width = max(1, int(width))
+        with self._lock:
+            if width == self.width:
+                return
+            self.width = width
+            self.hi_batch = min(
+                self.base_batch * 8 * width, self._clamp_cap
+            )
+            if self.max_batch > self.hi_batch:
+                self.max_batch = self.hi_batch
 
     def update(
         self, occupancy: int, queue_wait_s: float, service_s: float = 0.0

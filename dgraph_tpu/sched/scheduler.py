@@ -765,6 +765,17 @@ class CohortScheduler:
             return
         from dgraph_tpu.query import planner as _planner
 
+        # elastic mesh fault domain (mesh/fault.py): the batching
+        # CEILING scales with mesh width, and width now moves at
+        # runtime — a chip eviction shrinks the surviving sub-mesh, a
+        # staged rejoin widens it back.  Re-sample per flush so a
+        # degraded mesh is not asked to drain full-width cohorts.
+        try:
+            mesh = self._server.engine.arenas.mesh
+            if mesh is not None:
+                self._adaptive.set_width(int(mesh.shape["model"]))
+        except AttributeError:
+            pass
         if _planner.enabled():
             mb, fs = self._adaptive.update(occupancy, max_wait, service_s)
         else:

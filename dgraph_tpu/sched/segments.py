@@ -47,7 +47,11 @@ import threading
 from typing import Callable, Optional
 
 from dgraph_tpu.utils.failpoints import fail
-from dgraph_tpu.utils.metrics import SEGMENT_DISPATCHES, SEGMENT_YIELDS
+from dgraph_tpu.utils.metrics import (
+    QUERY_RESUMED,
+    SEGMENT_DISPATCHES,
+    SEGMENT_YIELDS,
+)
 
 _tls = threading.local()
 
@@ -117,6 +121,21 @@ def early_exit(driver: str) -> None:
     pagination satisfied / frontier drained mid-chain): the remaining
     segments are never dispatched."""
     SEGMENT_YIELDS.add("early_exit")
+
+
+def resume(driver: str, reason: str) -> None:
+    """Record one drain-and-resume (the elastic mesh fault domain,
+    mesh/fault.py): an in-flight segmented query observed an epoch flip
+    at a seam — or lost its chip mid-segment — fetched its carry to
+    host, re-planned under the new sub-mesh and continued.  ``reason``
+    ∈ ``epoch`` (flip observed at a seam), ``loss`` (the query's own
+    dispatch hit the evicted chip), ``hang`` (wedged collective:
+    remaining hops completed unsharded)."""
+    QUERY_RESUMED.add(reason)
+    ctx = current()
+    if ctx is not None and ctx.stats is not None:
+        r = ctx.stats.setdefault("resumed", {})
+        r[reason] = r.get(reason, 0) + 1
 
 
 def plan(n_steps: int, est_step_units: int, driver: str) -> int:

@@ -671,6 +671,13 @@ def _make_handler(srv: DgraphServer):
                         "enabled": _devguard.enabled(),
                         "domains": _devguard.summary(),
                     }
+                    # elastic mesh fault domain (mesh/fault.py): current
+                    # epoch, per-chip guard states, placement summary
+                    # and in-flight drain count — the operator's first
+                    # stop in the "Mesh fault domain" runbook
+                    dom = getattr(srv.engine.arenas, "mesh_fault", None)
+                    if dom is not None:
+                        detail["mesh"] = dom.status()
                     code = 200 if srv.health.ok() else 503
                     self._reply(code, json.dumps(detail).encode())
                 elif srv.health.ok():

@@ -74,6 +74,7 @@ from __future__ import annotations
 
 import os
 import queue
+import re
 import sys
 import threading
 import time
@@ -155,6 +156,30 @@ def classify(exc: BaseException) -> Optional[str]:
     return None
 
 
+# chip attribution: XLA device errors sometimes name the failing device
+# ("chip=3", and injected faults carry the same tag via the failpoint
+# chip= selector).  When a fault names a chip, the elastic mesh fault
+# domain (mesh/fault.py) evicts THAT chip and re-shards onto survivors
+# instead of latching the whole collective plane.
+_CHIP_RE = re.compile(r"\bchip=(\d+)\b")
+
+
+def chip_of(exc: BaseException) -> Optional[int]:
+    """The chip index a dispatch failure names, walking the exception
+    chain (a DeviceFaultError wraps the raw XLA/failpoint error); None
+    when the fault cannot be attributed to one chip — the caller must
+    then treat it as a whole-plane fault (the PR 15 path)."""
+    seen = set()
+    e: Optional[BaseException] = exc
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        m = _CHIP_RE.search(f"{type(e).__name__}: {e}")
+        if m:
+            return int(m.group(1))
+        e = e.__cause__ or e.__context__
+    return None
+
+
 class _Job:
     __slots__ = (
         "fn", "done", "result", "exc", "abandoned", "lock", "_race_serial",
@@ -193,8 +218,22 @@ class DeviceGuard:
         cooldown_s: Optional[float] = None,
         sick_after: Optional[int] = None,
         probe_fn: Optional[Callable[[], None]] = None,
+        on_readmit: Optional[Callable[[], None]] = None,
     ):
         self.domain = domain
+        # fault-attribution sink (elastic mesh, mesh/fault.py): consulted
+        # in run() after classify(); returning True means a SUB-domain
+        # (one chip's guard) owns the fault and this plane guard is not
+        # charged — the DeviceFaultError still raises so the seam can
+        # retry under the re-sharded plan.  Wired post-construction by
+        # the owning fault domain; None = every fault charges this guard.
+        self.fault_sink: Optional[
+            Callable[[str, str, BaseException], bool]
+        ] = None
+        # fired (outside the state lock) after a successful half-open
+        # probe re-admits the domain — the staged-rejoin trigger for
+        # per-chip sub-domains
+        self.on_readmit = on_readmit
         self.hang_ms = (
             hang_ms
             if hang_ms is not None
@@ -325,6 +364,14 @@ class DeviceGuard:
             kind = classify(job.exc)
             if kind is None:
                 raise job.exc  # not a device fault — never masked
+            sink = self.fault_sink
+            if sink is not None and sink(kind, op, job.exc):
+                # a sub-domain (one mesh chip) owns this fault: the
+                # plane guard stays un-charged — N−1 healthy chips keep
+                # their route — but the seam still hears about it
+                raise DeviceFaultError(
+                    self.domain, op, kind, str(job.exc)
+                ) from job.exc
             self.note_fault(kind, op, job.exc)
             raise DeviceFaultError(
                 self.domain, op, kind, str(job.exc)
@@ -405,6 +452,23 @@ class DeviceGuard:
                     self.probes_failed += 1
                     self._gate.open(time.monotonic())
         DEVICE_PROBES.add("ok" if ok else "fail")
+        if ok and self.on_readmit is not None:
+            # outside self._lock: staged rejoin (mesh/fault.py) runs
+            # warm dispatches and may re-latch this guard sick when the
+            # candidate plan fails to prove itself
+            try:
+                self.on_readmit()
+            except Exception as e:  # noqa: BLE001 — a failed rejoin
+                # hook must not kill the probe loop; the domain simply
+                # stays on the surviving sub-mesh until the next probe
+                from dgraph_tpu.utils.metrics import note_swallowed
+
+                note_swallowed("devguard.on_readmit", e)
+            if self.state == SICK:
+                # the hook re-latched (failed warm on a flapping chip):
+                # report un-healed so the probe loop keeps running —
+                # its start() during our own probe was a no-op
+                return False
         return ok
 
     # -- surfaces ------------------------------------------------------------
@@ -494,6 +558,19 @@ def get(domain: str = "device") -> DeviceGuard:
         g = _guards.get(domain)
         if g is None:
             g = _guards[domain] = DeviceGuard(domain)
+        return g
+
+
+def ensure(domain: str, **kwargs) -> DeviceGuard:
+    """The registry constructor for guards that need non-default wiring
+    (per-chip mesh sub-domains: ``sick_after=1``, a chip-targeted
+    probe_fn, the staged-rejoin on_readmit hook).  First caller's kwargs
+    win; later calls return the existing guard untouched — guards are
+    long-lived state machines, not config carriers."""
+    with _guards_lock:
+        g = _guards.get(domain)
+        if g is None:
+            g = _guards[domain] = DeviceGuard(domain, **kwargs)
         return g
 
 

@@ -40,7 +40,15 @@ Actions:
 ``p`` is the trigger probability (default 1.0), ``n`` caps how many
 times the action fires (default unlimited), ``after`` skips the first
 N matching probes (so a crash test can let a known number of writes
-through before pulling the plug).  All probability draws come
+through before pulling the plug).  ``chip`` (error/xla_oom only)
+attributes the injected fault to ONE mesh chip — the raised message
+carries ``chip=N``, which the elastic mesh fault domain's classifier
+(mesh/fault.py via ``devguard.chip_of``) reads to evict that chip and
+re-shard onto the survivors instead of latching the whole collective
+plane: ``device.mesh=error(p=1,n=1,chip=3)`` kills chip 3 exactly
+once.  Without ``chip`` the same site keeps the PR 15/17 behavior (the
+un-attributed plane fault that degrades the level to unsharded).  All
+probability draws come
 from ONE seeded RNG (``DGRAPH_TPU_FAILPOINT_SEED``, default 0), so a
 chaos run replays bit-identically: same seed + same call order = same
 faults.  Triggers are counted per site in
@@ -99,7 +107,7 @@ def _xla_oom_error(site: str) -> BaseException:
 
 
 class _Action:
-    __slots__ = ("kind", "p", "n", "ms", "after")
+    __slots__ = ("kind", "p", "n", "ms", "after", "chip")
 
     def __init__(
         self,
@@ -108,12 +116,14 @@ class _Action:
         n: int = -1,
         ms: float = 0.0,
         after: int = 0,
+        chip: int = -1,
     ):
         self.kind = kind
         self.p = p
         self.n = n          # remaining fires; -1 = unlimited
         self.ms = ms
         self.after = after  # remaining probes to let through untouched
+        self.chip = chip    # -1 = un-attributed (whole-plane) fault
 
     @classmethod
     def parse(cls, spec: str) -> "_Action":
@@ -128,15 +138,24 @@ class _Action:
                 continue
             k, _, v = part.partition("=")
             k = k.strip()
-            if k not in ("p", "n", "ms", "after"):
+            if k not in ("p", "n", "ms", "after", "chip"):
                 raise ValueError(f"bad failpoint param {k!r} in {spec!r}")
             kw[k] = float(v)
+        chip = int(kw.get("chip", -1))
+        if chip >= 0 and kind not in ("error", "xla_oom"):
+            # a crash/hang carries no exception for the classifier to
+            # read chip attribution from — rejecting the spec beats a
+            # selector that silently does nothing
+            raise ValueError(
+                f"chip= only attributes error/xla_oom, not {kind!r}"
+            )
         return cls(
             kind,
             p=float(kw.get("p", 1.0)),
             n=int(kw.get("n", -1)),
             ms=float(kw.get("ms", 0.0)),
             after=int(kw.get("after", 0)),
+            chip=chip,
         )
 
 
@@ -206,7 +225,7 @@ class Failpoints:
             if act.n > 0:
                 act.n -= 1
             self._hits[site] = self._hits.get(site, 0) + 1
-            kind, ms = act.kind, act.ms
+            kind, ms, chip = act.kind, act.ms, act.chip
         from dgraph_tpu.utils.metrics import FAILPOINTS_FIRED
 
         FAILPOINTS_FIRED.add(site)
@@ -221,10 +240,17 @@ class Failpoints:
 
             print(f"# failpoint crash: {site}", file=sys.stderr, flush=True)
             os._exit(86)
+        # chip=N rides the exception TEXT (not a field): the devguard
+        # classifier reads attribution off real XLA errors the same way
+        # (devguard.chip_of), so injected chip faults take the exact
+        # code path a genuine per-chip failure would
+        tag = f" (chip={chip})" if chip >= 0 else ""
         if kind == "error":
-            raise FailpointError(f"failpoint {site!r} injected error")
+            raise FailpointError(
+                f"failpoint {site!r} injected error{tag}"
+            )
         if kind == "xla_oom":
-            raise _xla_oom_error(site)
+            raise _xla_oom_error(site + tag)
 
     def hits(self, site: str) -> int:
         with self._lock:

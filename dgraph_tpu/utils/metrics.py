@@ -809,6 +809,33 @@ DEVICE_PROBES = metrics.labeled(
     "dgraph_device_probes_total", label="outcome"
 )
 
+# elastic mesh fault domain (mesh/fault.py, PR 20): MESH_EPOCH is the
+# epoch fence every dispatched mesh program carries (the MeshPlan
+# version at the last re-shard) — it moves exactly when the serving
+# sub-mesh does.  MESH_CHIPS_HEALTHY vs the boot width is the capacity
+# headline (8→7 = one chip evicted, still sharded; the plane only
+# degrades to unsharded when it hits 0 or latches whole-plane sick).
+# MESH_RESHARD counts epoch flips by cause (loss / rejoin / manual) and
+# MESH_RESHARD_SECONDS is the drain window each flip cost — plan
+# rebalance + stale-shard drop + gauge/epoch publication; queries keep
+# serving through it, resuming at their next segment seam.
+# QUERY_RESUMED counts in-flight queries that drained their carry to
+# host and resumed under a new plan (reason ∈ epoch/loss/hang): a
+# sustained rate with no matching reshards means a flapping chip is
+# churning epochs — see the docs/deploy.md runbook.
+MESH_EPOCH = metrics.gauge("dgraph_mesh_epoch")
+MESH_CHIPS_HEALTHY = metrics.gauge("dgraph_mesh_chips_healthy")
+MESH_RESHARD = metrics.labeled(
+    "dgraph_mesh_reshard_total", label="reason"
+)
+MESH_RESHARD_SECONDS = metrics.histogram(
+    "dgraph_mesh_reshard_seconds",
+    (0.001, 0.005, 0.025, 0.1, 0.5, 2.0, 10.0, 60.0),
+)
+QUERY_RESUMED = metrics.labeled(
+    "dgraph_query_resumed_total", label="reason"
+)
+
 
 # build identity + liveness: BUILD_INFO is the constant-1 gauge whose
 # labels carry what is running (the client_golang BuildInfo
